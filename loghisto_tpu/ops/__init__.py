@@ -1,0 +1,29 @@
+"""TPU compute kernels: codec, dense ingest, statistics, sketches."""
+
+from loghisto_tpu.ops.codec import (
+    compress,
+    compress_np,
+    compress_scalar,
+    decompress,
+    decompress_np,
+    decompress_scalar,
+)
+from loghisto_tpu.ops.stats import (
+    bucket_representatives,
+    dense_stats,
+    percentiles_sparse,
+    summarize_sparse,
+)
+
+__all__ = [
+    "compress",
+    "compress_np",
+    "compress_scalar",
+    "decompress",
+    "decompress_np",
+    "decompress_scalar",
+    "bucket_representatives",
+    "dense_stats",
+    "percentiles_sparse",
+    "summarize_sparse",
+]

@@ -1,0 +1,141 @@
+"""Histogram statistics: percentiles, sum/count/avg (reference layer L3 math).
+
+The reference extracts each percentile by sorting the sparse bucket list and
+walking the CDF — once per percentile per histogram per interval, with an
+acknowledged TODO to batch them (metrics.go:406-418).  Here the scan is a
+prefix sum + ``searchsorted`` computing *all* percentiles in one pass:
+
+  * Bucket indices are monotonic in value (the codec is sign-mirrored and
+    monotonic), so sorting by bucket index == sorting by representative value,
+    and for the dense tensor the buckets are *already* sorted — no sort at all.
+  * The reference's selection rule is "first representative where
+    float64(cum)/float64(total) >= p" (metrics.go:411-414).  The host
+    (NumPy) tier replicates the same float64 division before comparison so
+    edge cases round identically (e.g. p=.99 over 10_000 samples must hit
+    cum==9900 exactly).  The device tier keeps the cumsum exact in int32 and
+    performs the division in float32 (TPUs have no fast float64): selection
+    is bit-identical to the reference for per-metric interval counts up to
+    2^24 and within one bucket (i.e. within the 1% accuracy contract)
+    beyond; min (p=0) and max (p=1) are computed by exact populated-bucket
+    selection at any count.
+
+The jnp variants operate on a dense ``[num_metrics, num_buckets]`` count
+tensor where bucket axis index b represents codec bucket ``b - bucket_limit``;
+sums become a matvec against the representative values (MXU-friendly) and the
+percentile scan a row-wise cumsum + vmapped searchsorted.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from loghisto_tpu.config import PRECISION
+from loghisto_tpu.ops.codec import decompress, decompress_np
+
+
+def percentiles_sparse(
+    buckets: np.ndarray, counts: np.ndarray, ps: np.ndarray,
+    precision: int = PRECISION,
+) -> np.ndarray:
+    """Percentiles from a sparse (bucket, count) histogram (host tier).
+
+    Args:
+      buckets: int bucket indices, any order, each count > 0.
+      counts: occurrence counts per bucket.
+      ps: quantiles in [0, 1] (caller validates; reference glog-and-drops
+        out-of-range requests, metrics.go:378-385).
+
+    Returns bucket representative values, one per p.  An empty histogram
+    returns zeros for every p (consistent with dense_stats' empty-metric
+    behavior; the reference never processes empty histograms because names
+    only exist in its sparse map once a sample lands).
+    """
+    if len(np.asarray(buckets)) == 0:
+        return np.zeros(len(np.asarray(ps)))
+    order = np.argsort(buckets, kind="stable")
+    values = decompress_np(np.asarray(buckets)[order], precision)
+    cdf = np.cumsum(np.asarray(counts, dtype=np.uint64)[order])
+    total = float(cdf[-1])
+    # Same operation order as the reference: float(cum)/float(total) >= p.
+    cdfn = cdf.astype(np.float64) / total
+    idx = np.searchsorted(cdfn, np.asarray(ps, dtype=np.float64), side="left")
+    idx = np.minimum(idx, len(values) - 1)
+    return values[idx]
+
+
+def summarize_sparse(
+    buckets: np.ndarray, counts: np.ndarray, precision: int = PRECISION,
+) -> tuple[float, int]:
+    """(sum of representatives * counts, total count) — metrics.go:342-347."""
+    values = decompress_np(np.asarray(buckets), precision)
+    counts = np.asarray(counts, dtype=np.float64)
+    return float(np.dot(values, counts)), int(counts.sum())
+
+
+def bucket_representatives(
+    bucket_limit: int, precision: int = PRECISION, dtype=jnp.float32
+) -> jnp.ndarray:
+    """Representative value of every dense-axis bucket: index b maps to codec
+    bucket b - bucket_limit."""
+    idx = jnp.arange(2 * bucket_limit + 1, dtype=jnp.int32) - bucket_limit
+    return decompress(idx, precision).astype(dtype)
+
+
+def dense_stats(
+    acc: jnp.ndarray,
+    ps: jnp.ndarray,
+    bucket_limit: int,
+    precision: int = PRECISION,
+) -> dict[str, jnp.ndarray]:
+    """Full per-metric statistics from a dense [M, B] count tensor.
+
+    Returns dict with:
+      counts [M] int32 — per-metric total sample count (this interval; kept
+        integer so counts above 2^24 stay exact)
+      sums   [M]   — sum of bucket representatives weighted by counts
+      percentiles [M, P] — representative value at each quantile in ps
+
+    Percentile rule matches the reference exactly (see module docstring);
+    empty metrics (count == 0) return 0 for all stats, mirroring the
+    reference where empty histograms simply don't exist in the sparse map.
+    """
+    num_buckets = acc.shape[1]
+    acc_f = acc.astype(jnp.float32)
+    reps = bucket_representatives(bucket_limit, precision)
+    sums = acc_f @ reps  # matvec on the MXU
+    # Integer cumsum stays exact for any per-interval count the int32
+    # accumulator can hold; only the final division is float32.
+    cdf = jnp.cumsum(acc.astype(jnp.int32), axis=1)
+    counts = cdf[:, -1]
+    # Normalize by the cumsum's own last column: cdfn[-1] == 1.0 exactly
+    # (x/x in IEEE), so p=1.0 always lands inside the populated range.
+    total = jnp.maximum(counts, 1)[:, None].astype(jnp.float32)
+    cdfn = cdf.astype(jnp.float32) / total
+
+    ps = jnp.asarray(ps, dtype=jnp.float32)
+
+    # Exact populated-bucket endpoints, immune to division rounding:
+    # min = first bucket with count > 0, max = last bucket with count > 0.
+    populated = acc > 0
+    idx_min = jnp.argmax(populated, axis=1)
+    idx_max = (num_buckets - 1) - jnp.argmax(populated[:, ::-1], axis=1)
+
+    def row_search(cdfn_row, lo, hi):
+        # 0 < p < 1: first bucket where cdf/total >= p (empty prefix buckets
+        # have cdf 0 < p, so the hit always lands on a populated bucket).
+        # p == 0 / p == 1: the reference iterates only *populated* buckets,
+        # so these mean first/last populated bucket — selected exactly.
+        pos = jnp.searchsorted(cdfn_row, ps, side="left")
+        pos = jnp.minimum(pos, num_buckets - 1)
+        return jnp.where(ps <= 0, lo, jnp.where(ps >= 1, hi, pos))
+
+    idx = jax.vmap(row_search)(cdfn, idx_min, idx_max)
+    pct = reps[idx]
+    nonempty = (counts > 0)[:, None]
+    return {
+        "counts": counts,
+        "sums": sums,
+        "percentiles": jnp.where(nonempty, pct, 0.0),
+    }

@@ -1,0 +1,23 @@
+"""Test configuration: run JAX on a simulated 8-device CPU mesh.
+
+The reference has no multi-node surface to test (SURVEY.md §4); our mesh
+merges are tested without TPU hardware by forcing the CPU backend to expose
+8 virtual devices, so shard_map/psum paths execute for real in CI.
+
+Note: the environment's TPU plugin (axon) programmatically overrides
+``jax_platforms`` at interpreter startup, so setting the env var alone is not
+enough — we update the JAX config *after* import, before any backend is
+initialized.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

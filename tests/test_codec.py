@@ -1,0 +1,99 @@
+"""Codec parity tests — mirrors reference metrics_test.go:151-172 plus the
+readme's published bucket representatives."""
+
+import numpy as np
+import pytest
+
+from loghisto_tpu.ops import (
+    compress,
+    compress_np,
+    compress_scalar,
+    decompress,
+    decompress_np,
+    decompress_scalar,
+)
+
+# Values from reference TestCompress (metrics_test.go:152-158).
+GO_TEST_VALUES = [-421408208120481.0, -1.0, 0.0, 1.0, 214141241241241.0]
+
+
+def roundtrip_err(f, result):
+    if result == 0:
+        return abs(f - result)
+    return abs(f / result - 1)
+
+
+@pytest.mark.parametrize("f", GO_TEST_VALUES)
+def test_scalar_roundtrip_within_1pct(f):
+    assert roundtrip_err(f, decompress_scalar(compress_scalar(f))) <= 0.01
+
+
+def test_numpy_roundtrip_within_1pct():
+    vals = np.array(GO_TEST_VALUES)
+    out = decompress_np(compress_np(vals))
+    for f, r in zip(vals, out):
+        assert roundtrip_err(f, r) <= 0.01
+
+
+def test_jnp_roundtrip_within_1pct():
+    vals = np.array(GO_TEST_VALUES, dtype=np.float32)
+    out = np.asarray(decompress(compress(vals)))
+    for f, r in zip(vals, out):
+        assert roundtrip_err(float(f), float(r)) <= 0.01
+
+
+def test_numpy_matches_scalar_reference():
+    rng = np.random.default_rng(0)
+    vals = np.concatenate([
+        rng.uniform(-1e6, 1e6, 1000),
+        rng.uniform(-0.51, 0.51, 100),  # documented low-precision zone
+        np.array([0.0, 58.7, -58.7, 1e-9, -1e-9]),
+    ])
+    got = compress_np(vals)
+    want = np.array([compress_scalar(float(v)) for v in vals], dtype=np.int16)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_jnp_matches_numpy():
+    # The device path computes log1p in float32, which can round a value
+    # sitting within float32-eps of a bucket boundary into the adjacent
+    # bucket.  Adjacent representatives are within ~0.5% of the boundary
+    # value, so the 1% accuracy contract still holds; assert exactness up to
+    # off-by-one and the round-trip contract everywhere.
+    rng = np.random.default_rng(1)
+    vals = rng.uniform(-1e6, 1e6, 4096).astype(np.float32)
+    got = np.asarray(compress(vals))
+    want = compress_np(vals.astype(np.float64)).astype(np.int32)
+    diff = np.abs(got - want)
+    assert diff.max() <= 1
+    assert (diff != 0).mean() < 0.01
+    roundtrip = decompress_np(got)
+    err = np.abs(roundtrip / vals.astype(np.float64) - 1)
+    assert err.max() <= 0.01
+
+
+def test_readme_bucket_representative():
+    # The readme's published p50 of 58.74 ns is the representative of
+    # compress(58.7) — decompress(compress(58.7)) == 58.7398917... exactly
+    # (reference readme.md:42; SURVEY.md §2 behavioral contract).
+    rep = decompress_scalar(compress_scalar(58.7))
+    assert abs(rep - 58.7398917) < 1e-6
+
+
+def test_zero_maps_to_bucket_zero_exactly():
+    assert compress_scalar(0.0) == 0
+    assert decompress_scalar(0) == 0.0
+
+
+def test_negative_values_mirror():
+    for v in (0.7, 3.0, 1e5):
+        assert compress_scalar(-v) == -compress_scalar(v)
+        b = compress_scalar(v)
+        assert decompress_scalar(-b) == -decompress_scalar(b)
+
+
+def test_saturation_instead_of_wrap():
+    # Deviation from Go (documented in codec.py): beyond ~1e142 we saturate.
+    assert compress_scalar(1e300) == 32767
+    assert compress_scalar(-1e300) == -32767
+    assert compress_np(np.array([1e300]))[0] == 32767

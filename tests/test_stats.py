@@ -1,0 +1,166 @@
+"""Percentile/statistics parity tests — mirrors reference
+metrics_test.go:111-149 (TestPercentile) and the dense device-tier scan."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from loghisto_tpu.config import MetricConfig
+from loghisto_tpu.ops import (
+    compress_np,
+    dense_stats,
+    percentiles_sparse,
+    summarize_sparse,
+)
+
+# Reference TestPercentile distribution (metrics_test.go:112-127).  Values are
+# used directly as bucket representatives there; we reproduce by finding
+# buckets whose representatives we then compare within 1%.
+GO_DIST = {10: 9000, 25: 900, 33: 90, 47: 9, 500: 1}
+GO_EXPECTED = {0: 10, 0.99: 25, 0.999: 33, 0.9991: 47, 0.9999: 47, 1: 500}
+
+
+def _sparse_from_values(dist):
+    buckets = compress_np(np.array(list(dist.keys()), dtype=np.float64))
+    counts = np.array(list(dist.values()), dtype=np.uint64)
+    return buckets, counts
+
+
+def test_percentile_go_table():
+    buckets, counts = _sparse_from_values(GO_DIST)
+    ps = np.array(list(GO_EXPECTED.keys()), dtype=np.float64)
+    got = percentiles_sparse(buckets, counts, ps)
+    for p, expected, actual in zip(ps, GO_EXPECTED.values(), got):
+        assert abs(expected / actual - 1) <= 0.01, (p, expected, actual)
+
+
+def test_percentile_exact_edge():
+    # p=.99 over 10_000 samples must select the bucket where cum==9900
+    # exactly — guards the float(cum)/float(total) >= p operation order.
+    buckets = np.array([100, 200], dtype=np.int16)
+    counts = np.array([9900, 100], dtype=np.uint64)
+    got = percentiles_sparse(buckets, counts, np.array([0.99]))
+    want = percentiles_sparse(buckets, counts, np.array([0.0]))
+    assert got[0] == want[0]  # p=.99 satisfied by the first bucket
+
+
+def test_percentile_p0_p1():
+    buckets, counts = _sparse_from_values(GO_DIST)
+    got = percentiles_sparse(buckets, counts, np.array([0.0, 1.0]))
+    assert abs(got[0] / 10 - 1) <= 0.01
+    assert abs(got[1] / 500 - 1) <= 0.01
+
+
+def test_percentile_negative_values():
+    dist = {-100: 50, -1: 25, 2: 25}
+    buckets, counts = _sparse_from_values(dist)
+    got = percentiles_sparse(buckets, counts, np.array([0.0, 0.5, 0.75, 1.0]))
+    assert abs(got[0] / -100 - 1) <= 0.01
+    # cum hits exactly 0.5 at the first (most negative) bucket -> -100.
+    assert abs(got[1] / -100 - 1) <= 0.01
+    assert abs(got[2] / -1 - 1) <= 0.01
+    assert abs(got[3] / 2 - 1) <= 0.01
+
+
+def test_summarize_sparse_golden_331132():
+    # Reference TestProcessedBroadcast: samples 33, 59, 330000 produce
+    # histogram1_sum == 331132 *after* codec round-trip (raw sum is 330092)
+    # — metrics_test.go:294-304, SURVEY.md §4.
+    vals = np.array([33.0, 59.0, 330000.0])
+    buckets = compress_np(vals)
+    uniq, cnt = np.unique(buckets, return_counts=True)
+    s, c = summarize_sparse(uniq, cnt)
+    assert int(s) == 331132
+    assert c == 3
+
+
+@pytest.fixture
+def cfg():
+    return MetricConfig(bucket_limit=1024)
+
+
+def _dense_from_sparse(buckets, counts, cfg, m=1):
+    acc = np.zeros((m, cfg.num_buckets), dtype=np.int32)
+    acc[0, np.asarray(buckets, dtype=np.int64) + cfg.bucket_limit] = counts
+    return jnp.asarray(acc)
+
+
+def test_dense_stats_matches_sparse(cfg):
+    buckets, counts = _sparse_from_values(GO_DIST)
+    acc = _dense_from_sparse(buckets, counts, cfg)
+    ps = np.array(list(GO_EXPECTED.keys()), dtype=np.float64)
+    out = dense_stats(acc, ps, cfg.bucket_limit)
+    sparse = percentiles_sparse(buckets, counts, ps)
+    np.testing.assert_allclose(
+        np.asarray(out["percentiles"][0]), sparse, rtol=1e-5
+    )
+    s, c = summarize_sparse(buckets, counts)
+    assert int(out["counts"][0]) == c
+    assert abs(float(out["sums"][0]) / s - 1) < 1e-5
+
+
+def test_dense_stats_p0_skips_empty_buckets(cfg):
+    # Leading empty dense buckets must not be selected for p=0.
+    acc = np.zeros((2, cfg.num_buckets), dtype=np.int32)
+    acc[0, cfg.bucket_limit + 300] = 7  # single populated bucket
+    out = dense_stats(jnp.asarray(acc), np.array([0.0, 1.0]), cfg.bucket_limit)
+    p = np.asarray(out["percentiles"])
+    assert p[0, 0] == p[0, 1] != 0  # min == max == the one bucket
+    # empty metric row -> zeros
+    assert p[1, 0] == 0 and p[1, 1] == 0
+    assert float(out["counts"][1]) == 0
+
+
+def test_sparse_empty_returns_zeros():
+    out = percentiles_sparse(
+        np.array([], dtype=np.int16),
+        np.array([], dtype=np.uint64),
+        np.array([0.0, 0.5, 1.0]),
+    )
+    np.testing.assert_array_equal(out, np.zeros(3))
+
+
+def test_config_validates_bucket_limit():
+    with pytest.raises(ValueError):
+        MetricConfig(bucket_limit=10_000)  # float32 reps would overflow
+    with pytest.raises(ValueError):
+        MetricConfig(bucket_limit=0)
+
+
+def test_dense_stats_exact_max_with_huge_counts(cfg):
+    # 2^26 samples in one bucket + a single outlier: float32 division
+    # rounding must not cost us the true max (exact populated-bucket
+    # selection), nor the true min.
+    acc = np.zeros((1, cfg.num_buckets), dtype=np.int32)
+    acc[0, cfg.bucket_limit + 100] = 1 << 26
+    acc[0, cfg.bucket_limit + 900] = 1
+    acc[0, cfg.bucket_limit - 500] = 1
+    out = dense_stats(jnp.asarray(acc), np.array([0.0, 1.0]), cfg.bucket_limit)
+    p = np.asarray(out["percentiles"][0])
+    want_min = float(np.asarray(
+        dense_stats(jnp.asarray(acc), np.array([0.0]), cfg.bucket_limit)["percentiles"][0][0]))
+    assert p[1] > 0  # max is the outlier's bucket representative
+    rep900 = float(np.exp(900 / 100) - 1)
+    assert abs(p[1] / rep900 - 1) < 1e-5
+    rep_neg500 = -(float(np.exp(500 / 100)) - 1)
+    assert abs(p[0] / rep_neg500 - 1) < 1e-5
+    assert want_min == p[0]
+    assert float(out["counts"][0]) == (1 << 26) + 2
+
+
+def test_dense_stats_many_metrics(cfg):
+    rng = np.random.default_rng(2)
+    m = 16
+    acc = np.zeros((m, cfg.num_buckets), dtype=np.int32)
+    ps = np.array([0.0, 0.5, 0.9, 0.99, 1.0])
+    sparse_out = []
+    for i in range(m):
+        vals = rng.lognormal(mean=5, sigma=2, size=500)
+        buckets = np.clip(compress_np(vals), -cfg.bucket_limit, cfg.bucket_limit)
+        uniq, cnt = np.unique(buckets, return_counts=True)
+        acc[i, uniq.astype(np.int64) + cfg.bucket_limit] = cnt
+        sparse_out.append(percentiles_sparse(uniq, cnt, ps))
+    out = dense_stats(jnp.asarray(acc), ps, cfg.bucket_limit)
+    np.testing.assert_allclose(
+        np.asarray(out["percentiles"]), np.stack(sparse_out), rtol=1e-4
+    )
