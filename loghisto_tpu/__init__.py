@@ -1,0 +1,27 @@
+"""loghisto_tpu — TPU-native metrics framework with the capabilities of
+spacejam/loghisto: counters and sampling-free log-bucketed histograms whose
+percentiles stay within 1% of the true value, aggregated by XLA/Pallas
+kernels over a dense bucket tensor and merged across device meshes with
+psum collectives.  See SURVEY.md for the structural map to the reference."""
+
+from loghisto_tpu.channel import Channel, ChannelClosed
+from loghisto_tpu.config import DEFAULT_PERCENTILES, MetricConfig
+from loghisto_tpu.metrics import (
+    MetricSystem,
+    ProcessedMetricSet,
+    RawMetricSet,
+    TimerToken,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Channel",
+    "ChannelClosed",
+    "DEFAULT_PERCENTILES",
+    "MetricConfig",
+    "MetricSystem",
+    "ProcessedMetricSet",
+    "RawMetricSet",
+    "TimerToken",
+]

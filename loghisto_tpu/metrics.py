@@ -1,0 +1,543 @@
+"""Host-facing MetricSystem: ingest, collection, processing, broadcast.
+
+This is the rebuild of the reference's layers L2+L3 (metrics.go), redesigned
+for a batch-oriented TPU backend instead of Go's per-sample
+mutex-and-atomics design:
+
+  * Ingest (`counter`/`histogram`/`start_timer`) appends to *lock-striped
+    shard buffers* — histogram samples are stored raw as (value) appends per
+    name, NOT bucketed per call.  Bucketing happens once per interval as a
+    vectorized batch (NumPy on the host tier, XLA/Pallas on the device
+    tier), which is what makes the hot path cheap and the math TPU-shaped.
+  * The reaper is an interval-aligned daemon thread: swap-and-reset the
+    shard buffers, fold counters into the lifetime store, poll gauges,
+    broadcast a RawMetricSet, then hand statistic derivation to a bounded
+    worker pool which broadcasts the ProcessedMetricSet (reference
+    metrics.go:508-653 semantics: non-blocking broadcast, strike eviction,
+    whole-interval shedding when the pool is saturated).
+
+Behavioral parity notes (SURVEY.md §2):
+  * naming scheme: counters -> bare name (lifetime) and `<name>_rate`
+    (interval delta); histograms -> `<name>_{count,sum,avg}`, percentile
+    labels `label % name`, lifetime `<name>_agg_{avg,count,sum}`; gauges
+    verbatim (metrics.go:481-506, 585-608).
+  * subscribers are evicted after `config.eviction_strikes` consecutive
+    failed deliveries (the reference's code evicts on the 2nd;
+    metrics.go:574,620) by closing their channel.
+  * interval timestamps are floored to interval boundaries
+    (metrics.go:421-423).
+  * out-of-range percentile specs are logged and skipped
+    (metrics.go:378-385).
+  * `go_compat=True` reproduces the uint64-truncated lifetime sums and
+    integer `_agg_avg` division (metrics.go:374, 601-602).
+
+Deliberate improvements over the reference (documented deviations):
+  * lifetime `_agg_*` folding happens once at *collection*, not during
+    processing — `process_metrics` is pure, double-processing a
+    RawMetricSet cannot double-count, and shed intervals still reach the
+    lifetime aggregates.
+  * a raising gauge function is logged and skipped instead of taking down
+    the reaper.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import itertools
+import logging
+import os
+import queue
+import threading
+import time
+from array import array
+from typing import Callable, Dict, Mapping, Optional
+
+import numpy as np
+
+from loghisto_tpu.channel import Channel
+from loghisto_tpu.config import DEFAULT_PERCENTILES, MetricConfig
+from loghisto_tpu.ops.codec import compress_np, decompress_np
+from loghisto_tpu.ops.stats import percentiles_sparse
+from loghisto_tpu.utils.sysstats import default_gauges
+
+logger = logging.getLogger("loghisto_tpu")
+
+
+@dataclasses.dataclass
+class RawMetricSet:
+    """Per-interval raw collection output (reference metrics.go:54-60).
+
+    histograms maps name -> {bucket_index: count} — sparse, full int16
+    span, exactly mergeable across systems/hosts by elementwise addition.
+    """
+
+    time: _dt.datetime
+    counters: Dict[str, int]
+    rates: Dict[str, int]
+    histograms: Dict[str, Dict[int, int]]
+    gauges: Dict[str, float]
+
+
+@dataclasses.dataclass
+class ProcessedMetricSet:
+    """Flat human-readable metrics (reference metrics.go:47-50)."""
+
+    time: _dt.datetime
+    metrics: Dict[str, float]
+
+
+class TimerToken:
+    """Concurrent named duration timing (reference metrics.go:62-67).
+
+    stop() records the duration as a histogram sample in nanoseconds and
+    returns it."""
+
+    __slots__ = ("name", "start_ns", "_system")
+
+    def __init__(self, name: str, system: "MetricSystem"):
+        self.name = name
+        self._system = system
+        self.start_ns = time.perf_counter_ns()
+
+    def stop(self) -> int:
+        duration_ns = time.perf_counter_ns() - self.start_ns
+        self._system.histogram(self.name, float(duration_ns))
+        return duration_ns
+
+    # Context-manager sugar (not in the reference, natural in Python).
+    def __enter__(self) -> "TimerToken":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    Stop = stop
+
+
+class _Shard:
+    """One lock stripe of the ingest path: counter dict + histogram
+    append-buffers.  Threads hash to a shard; contention is 1/num_shards."""
+
+    __slots__ = ("lock", "counters", "histograms")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counters: Dict[str, int] = {}
+        self.histograms: Dict[str, array] = {}
+
+
+def _num_default_shards() -> int:
+    return max(4, min(64, (os.cpu_count() or 4)))
+
+
+class MetricSystem:
+    """Collects and distributes metrics (rebuild of reference
+    metrics.go:79-195)."""
+
+    def __init__(
+        self,
+        interval: float = 60.0,
+        sys_stats: bool = True,
+        config: MetricConfig = MetricConfig(),
+        num_shards: Optional[int] = None,
+    ):
+        if interval <= 0:
+            raise ValueError("interval must be positive seconds")
+        self.interval = float(interval)
+        self.config = config
+        self._percentiles: Dict[str, float] = dict(DEFAULT_PERCENTILES)
+
+        self._shards = [_Shard() for _ in range(num_shards or _num_default_shards())]
+        # Threads are assigned shards round-robin via a thread-local (a
+        # modulo of thread ids degenerates badly: glibc pthread ids share
+        # their low bits across threads).
+        self._thread_local = threading.local()
+        self._shard_counter = itertools.count()
+
+        # lifetime stores
+        self._store_lock = threading.Lock()
+        self._counter_store: Dict[str, int] = {}
+        # name -> [lifetime_sum, lifetime_count]; sums are floats unless
+        # go_compat truncates them per interval like the reference's uint64.
+        self._histogram_agg_store: Dict[str, list] = {}
+
+        self._gauge_lock = threading.Lock()
+        self._gauge_funcs: Dict[str, Callable[[], float]] = {}
+        if sys_stats:
+            self._gauge_funcs.update(default_gauges())
+
+        # subscription management: requests queue up and apply at the tick
+        self._sub_requests: "queue.Queue[tuple[str, Channel]]" = queue.Queue()
+        self._subscribers_lock = threading.Lock()
+        self._raw_subscribers: Dict[Channel, int] = {}
+        self._processed_subscribers: Dict[Channel, int] = {}
+
+        self._lifecycle_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._reaper_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ #
+    # ingest hot path (reference layer L2)
+    # ------------------------------------------------------------------ #
+
+    def _shard(self) -> _Shard:
+        idx = getattr(self._thread_local, "shard_idx", None)
+        if idx is None:
+            idx = next(self._shard_counter) % len(self._shards)
+            self._thread_local.shard_idx = idx
+        return self._shards[idx]
+
+    def counter(self, name: str, amount: int = 1) -> None:
+        """Record `amount` occurrences of an event (metrics.go:251-269)."""
+        shard = self._shard()
+        with shard.lock:
+            shard.counters[name] = shard.counters.get(name, 0) + amount
+
+    def histogram(self, name: str, value: float) -> None:
+        """Record one continuous value (metrics.go:273-295).  Values are
+        appended raw; log-bucketing happens vectorized at collection."""
+        shard = self._shard()
+        with shard.lock:
+            buf = shard.histograms.get(name)
+            if buf is None:
+                buf = shard.histograms[name] = array("d")
+            buf.append(value)
+
+    def histogram_batch(self, name: str, values) -> None:
+        """Record many values of one metric in a single call — the natural
+        API for batch-oriented callers (no reference equivalent; the Go hot
+        loop is per-sample)."""
+        shard = self._shard()
+        with shard.lock:
+            buf = shard.histograms.get(name)
+            if buf is None:
+                buf = shard.histograms[name] = array("d")
+            buf.extend(values)
+
+    def start_timer(self, name: str) -> TimerToken:
+        """Begin a named timing; stop() the returned token (metrics.go:232)."""
+        return TimerToken(name, self)
+
+    def register_gauge_func(self, name: str, f: Callable[[], float]) -> None:
+        with self._gauge_lock:
+            self._gauge_funcs[name] = f
+
+    def deregister_gauge_func(self, name: str) -> None:
+        with self._gauge_lock:
+            self._gauge_funcs.pop(name, None)
+
+    def specify_percentiles(self, percentiles: Mapping[str, float]) -> None:
+        """Override the default percentile set (metrics.go:197-201)."""
+        self._percentiles = dict(percentiles)
+
+    # ------------------------------------------------------------------ #
+    # subscription boundary (reference layer L3)
+    # ------------------------------------------------------------------ #
+
+    def subscribe_to_raw_metrics(self, ch: Channel) -> None:
+        self._sub_requests.put(("sub_raw", ch))
+
+    def unsubscribe_from_raw_metrics(self, ch: Channel) -> None:
+        self._sub_requests.put(("unsub_raw", ch))
+
+    def subscribe_to_processed_metrics(self, ch: Channel) -> None:
+        self._sub_requests.put(("sub_processed", ch))
+
+    def unsubscribe_from_processed_metrics(self, ch: Channel) -> None:
+        self._sub_requests.put(("unsub_processed", ch))
+
+    def _update_subscribers(self) -> None:
+        """Apply queued (un)subscribe requests — once per tick, like the
+        reference's channel-of-channels drain (metrics.go:508-525)."""
+        with self._subscribers_lock:
+            while True:
+                try:
+                    op, ch = self._sub_requests.get_nowait()
+                except queue.Empty:
+                    return
+                if op == "sub_raw":
+                    self._raw_subscribers.setdefault(ch, 0)
+                elif op == "unsub_raw":
+                    self._raw_subscribers.pop(ch, None)
+                elif op == "sub_processed":
+                    self._processed_subscribers.setdefault(ch, 0)
+                elif op == "unsub_processed":
+                    self._processed_subscribers.pop(ch, None)
+
+    def _broadcast(self, subscribers: Dict[Channel, int], item) -> None:
+        """Non-blocking delivery with strike eviction (metrics.go:565-581):
+        a full channel earns a strike; `eviction_strikes` consecutive
+        strikes closes and forgets the channel.  Must be called with
+        _subscribers_lock held."""
+        evict = []
+        for ch in subscribers:
+            if ch.offer(item):
+                subscribers[ch] = 0
+            else:
+                subscribers[ch] += 1
+                logger.error(
+                    "a subscriber has allowed their channel to fill up; "
+                    "dropping their metrics rather than blocking"
+                )
+                if subscribers[ch] >= self.config.eviction_strikes:
+                    logger.error(
+                        "subscriber dropped metrics %d times in a row; "
+                        "closing the channel",
+                        subscribers[ch],
+                    )
+                    evict.append(ch)
+        for ch in evict:
+            del subscribers[ch]
+            ch.close()
+
+    # ------------------------------------------------------------------ #
+    # collection (reference layer L3: collectRawMetrics, metrics.go:420-479)
+    # ------------------------------------------------------------------ #
+
+    def _interval_floor(self, now: Optional[float] = None) -> _dt.datetime:
+        """Timestamps are floored to interval boundaries (metrics.go:421)."""
+        now = time.time() if now is None else now
+        ns = int(now * 1e9)
+        interval_ns = max(1, int(self.interval * 1e9))
+        floored = ns // interval_ns * interval_ns
+        return _dt.datetime.fromtimestamp(floored / 1e9, tz=_dt.timezone.utc)
+
+    def collect_raw_metrics(self) -> RawMetricSet:
+        ts = self._interval_floor()
+
+        fresh_counters: Dict[str, int] = {}
+        hist_buffers: Dict[str, list] = {}
+        for shard in self._shards:
+            with shard.lock:
+                counters, shard.counters = shard.counters, {}
+                hists, shard.histograms = shard.histograms, {}
+            for name, amount in counters.items():
+                fresh_counters[name] = fresh_counters.get(name, 0) + amount
+            for name, buf in hists.items():
+                hist_buffers.setdefault(name, []).append(buf)
+
+        rates = dict(fresh_counters)
+        with self._store_lock:
+            for name, amount in fresh_counters.items():
+                self._counter_store[name] = (
+                    self._counter_store.get(name, 0) + amount
+                )
+            counters = dict(self._counter_store)
+
+        histograms: Dict[str, Dict[int, int]] = {}
+        for name, bufs in hist_buffers.items():
+            values = np.concatenate(
+                [np.frombuffer(b, dtype=np.float64) for b in bufs]
+            ) if len(bufs) > 1 else np.frombuffer(bufs[0], dtype=np.float64)
+            buckets = compress_np(values, self.config.precision)
+            uniq, cnt = np.unique(buckets, return_counts=True)
+            histograms[name] = {
+                int(b): int(c) for b, c in zip(uniq, cnt)
+            }
+            # Fold this interval into the lifetime aggregate store HERE, at
+            # collection — exactly once per interval.  (The reference folds
+            # during processing, metrics.go:359-376, which double-counts if
+            # a RawMetricSet is processed twice and *under*-counts shed
+            # intervals; folding at collection fixes both.)  The folded sum
+            # is the decompressed-representative sum, like the reference's.
+            reps = decompress_np(uniq, self.config.precision)
+            total_sum = float(np.dot(reps, cnt.astype(np.float64)))
+            total_count = int(cnt.sum())
+            sum_inc = int(total_sum) if self.config.go_compat else total_sum
+            with self._store_lock:
+                entry = self._histogram_agg_store.setdefault(name, [0, 0])
+                entry[0] += sum_inc
+                entry[1] += total_count
+
+        with self._gauge_lock:
+            gauge_funcs = dict(self._gauge_funcs)
+        gauges = {}
+        for name, f in gauge_funcs.items():
+            try:
+                gauges[name] = float(f())
+            except Exception:
+                logger.exception("gauge func %r raised; skipping", name)
+
+        return RawMetricSet(
+            time=ts,
+            counters=counters,
+            rates=rates,
+            histograms=histograms,
+            gauges=gauges,
+        )
+
+    # ------------------------------------------------------------------ #
+    # processing (reference processMetrics/processHistograms,
+    # metrics.go:334-418, 481-506)
+    # ------------------------------------------------------------------ #
+
+    def _process_histogram(
+        self, name: str, bucket_counts: Mapping[int, int]
+    ) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        buckets = np.fromiter(bucket_counts.keys(), dtype=np.int64)
+        counts = np.fromiter(bucket_counts.values(), dtype=np.uint64)
+        values = decompress_np(buckets, self.config.precision)
+        total_sum = float(np.dot(values, counts.astype(np.float64)))
+        total_count = int(counts.sum())
+
+        out[f"{name}_count"] = float(total_count)
+        out[f"{name}_sum"] = total_sum
+        out[f"{name}_avg"] = total_sum / total_count if total_count else 0.0
+
+        labels, ps = [], []
+        for label, p in self._percentiles.items():
+            if not 0.0 <= p <= 1.0:
+                logger.error(
+                    "unable to calculate percentile %r=%s: must be in [0,1]",
+                    label, p,
+                )
+                continue
+            labels.append(label)
+            ps.append(p)
+        if labels:
+            pct = percentiles_sparse(
+                buckets, counts, np.asarray(ps), self.config.precision
+            )
+            for label, value in zip(labels, pct):
+                out[label % name] = float(value)
+        return out
+
+    def process_metrics(self, raw: RawMetricSet) -> ProcessedMetricSet:
+        metrics: Dict[str, float] = {}
+        for name, count in raw.counters.items():
+            metrics[name] = float(count)
+        for name, count in raw.rates.items():
+            metrics[f"{name}_rate"] = float(count)
+        for name, bucket_counts in raw.histograms.items():
+            metrics.update(self._process_histogram(name, bucket_counts))
+        metrics.update(raw.gauges)
+        return ProcessedMetricSet(time=raw.time, metrics=metrics)
+
+    def _attach_aggregates(
+        self, processed: ProcessedMetricSet, raw: RawMetricSet
+    ) -> None:
+        """Add lifetime `_agg_{avg,count,sum}` (reference metrics.go:589-608)."""
+        with self._store_lock:
+            snapshot = {
+                name: (entry[0], entry[1])
+                for name, entry in self._histogram_agg_store.items()
+                if name in raw.histograms
+            }
+        for name, (agg_sum, agg_count) in snapshot.items():
+            if agg_count <= 0:
+                continue
+            if self.config.go_compat:
+                avg = float(int(agg_sum) // int(agg_count))
+            else:
+                avg = agg_sum / agg_count
+            processed.metrics[f"{name}_agg_avg"] = avg
+            processed.metrics[f"{name}_agg_count"] = float(agg_count)
+            processed.metrics[f"{name}_agg_sum"] = float(agg_sum)
+
+    # ------------------------------------------------------------------ #
+    # reaper loop (reference metrics.go:527-653)
+    # ------------------------------------------------------------------ #
+
+    def _reaper(self, shutdown: threading.Event) -> None:
+        # Bounded worker pool for statistic derivation; queue and shutdown
+        # event are per reaper generation, so a restarted system can never
+        # inherit stale tasks or shutdown sentinels.
+        process_queue: "queue.Queue[Callable[[], None]]" = queue.Queue(16)
+        n_workers = max((os.cpu_count() or 4) // 4, 4)
+        workers = [
+            threading.Thread(
+                target=self._worker, args=(process_queue, shutdown),
+                daemon=True, name="loghisto-worker",
+            )
+            for _ in range(n_workers)
+        ]
+        for w in workers:
+            w.start()
+
+        while True:
+            now = time.time()
+            tts = self.interval - (now % self.interval)
+            if shutdown.wait(timeout=tts):
+                return
+            try:
+                self._tick(process_queue)
+            except Exception:
+                # A failing collection/broadcast must not kill metric
+                # collection for the process lifetime.
+                logger.exception("reaper tick failed; continuing")
+
+    def _tick(self, process_queue: "queue.Queue") -> None:
+        raw = self.collect_raw_metrics()
+        self._update_subscribers()
+
+        with self._subscribers_lock:
+            self._broadcast(self._raw_subscribers, raw)
+
+        def send_processed(raw=raw):
+            processed = self.process_metrics(raw)
+            self._attach_aggregates(processed, raw)
+            with self._subscribers_lock:
+                self._broadcast(self._processed_subscribers, processed)
+
+        try:
+            process_queue.put_nowait(send_processed)
+        except queue.Full:
+            # Shed the whole interval rather than stall the reaper
+            # (reference metrics.go:630-637).
+            logger.error(
+                "metric processing is saturated; dropping the %s "
+                "interval rather than blocking the reaper",
+                raw.time,
+            )
+
+    def _worker(
+        self, process_queue: "queue.Queue", shutdown: threading.Event
+    ) -> None:
+        while True:
+            try:
+                task = process_queue.get(timeout=0.1)
+            except queue.Empty:
+                if shutdown.is_set():
+                    return
+                continue
+            try:
+                task()
+            except Exception:
+                logger.exception("metric processing task failed")
+
+    def start(self) -> None:
+        """Start the reaper; idempotent while running (metrics.go:644-648)."""
+        with self._lifecycle_lock:
+            if self._reaper_thread is not None and self._reaper_thread.is_alive():
+                return
+            self._shutdown = threading.Event()
+            self._reaper_thread = threading.Thread(
+                target=self._reaper, args=(self._shutdown,),
+                daemon=True, name="loghisto-reaper",
+            )
+            self._reaper_thread.start()
+
+    def stop(self) -> None:
+        """Shut the reaper and worker pool down (metrics.go:651-653).
+        Joins the reaper so an immediate start() spawns a fresh one."""
+        with self._lifecycle_lock:
+            self._shutdown.set()
+            t = self._reaper_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+
+    # Go-style aliases for drop-in familiarity with the reference API.
+    Counter = counter
+    Histogram = histogram
+    StartTimer = start_timer
+    RegisterGaugeFunc = register_gauge_func
+    DeregisterGaugeFunc = deregister_gauge_func
+    SpecifyPercentiles = specify_percentiles
+    SubscribeToRawMetrics = subscribe_to_raw_metrics
+    UnsubscribeFromRawMetrics = unsubscribe_from_raw_metrics
+    SubscribeToProcessedMetrics = subscribe_to_processed_metrics
+    UnsubscribeFromProcessedMetrics = unsubscribe_from_processed_metrics
+    Start = start
+    Stop = stop

@@ -1,0 +1,208 @@
+"""Live-reaper concurrency/lifecycle tests — mirrors reference
+metrics_test.go:242-363 (TestUpdateSubscribers, TestProcessedBroadcast,
+TestRawBroadcast, TestMetricSystemStop) plus strike-eviction and shedding
+behaviors from SURVEY.md §2."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from loghisto_tpu import Channel, ChannelClosed, MetricSystem
+from loghisto_tpu.config import MetricConfig
+
+INTERVAL = 0.02  # fast ticks for tests
+WAIT = 2.0
+
+
+def _get(ch, timeout=WAIT):
+    return ch.get(timeout=timeout)
+
+
+def test_processed_broadcast_golden():
+    # Reference TestProcessedBroadcast golden values (metrics_test.go:289).
+    ms = MetricSystem(interval=INTERVAL, sys_stats=False)
+    ch = Channel(128)
+    ms.subscribe_to_processed_metrics(ch)
+    ms.histogram("histogram1", 33)
+    ms.histogram("histogram1", 59)
+    ms.histogram("histogram1", 330000)
+    ms.start()
+    try:
+        processed = _get(ch)
+        m = processed.metrics
+        assert int(m["histogram1_sum"]) == 331132
+        assert int(m["histogram1_agg_avg"]) == 110377
+        assert int(m["histogram1_count"]) == 3
+    finally:
+        ms.unsubscribe_from_processed_metrics(ch)
+        ms.stop()
+
+
+def test_raw_broadcast():
+    ms = MetricSystem(interval=INTERVAL, sys_stats=False)
+    ch = Channel(128)
+    ms.subscribe_to_raw_metrics(ch)
+    ms.counter("counter2", 10)
+    ms.counter("counter2", 111)
+    ms.start()
+    try:
+        raw = _get(ch)
+        assert raw.counters["counter2"] == 121
+        assert raw.rates["counter2"] == 121
+    finally:
+        ms.unsubscribe_from_raw_metrics(ch)
+        ms.stop()
+
+
+def test_subscribe_unsubscribe_lifecycle():
+    ms = MetricSystem(interval=INTERVAL, sys_stats=False)
+    raw_ch, proc_ch = Channel(4), Channel(4)
+    ms.subscribe_to_raw_metrics(raw_ch)
+    ms.subscribe_to_processed_metrics(proc_ch)
+    ms.counter("counter5", 33)
+    ms.start()
+    try:
+        assert _get(raw_ch) is not None
+        assert _get(proc_ch) is not None
+        ms.unsubscribe_from_raw_metrics(raw_ch)
+        ms.unsubscribe_from_processed_metrics(proc_ch)
+        # wait for the unsubscription to apply at the next tick, then drain
+        time.sleep(5 * INTERVAL)
+        try:
+            while True:
+                raw_ch.get(block=False)
+        except (queue.Empty, ChannelClosed):
+            pass
+        time.sleep(5 * INTERVAL)
+        with pytest.raises((queue.Empty, ChannelClosed)):
+            raw_ch.get(block=False)
+    finally:
+        ms.stop()
+
+
+def test_slow_subscriber_evicted_and_channel_closed():
+    # A capacity-1 channel that is never drained fills at the first tick,
+    # then earns strikes; after eviction_strikes consecutive failures the
+    # channel must be closed (reference metrics.go:565-581).
+    ms = MetricSystem(
+        interval=INTERVAL, sys_stats=False,
+        config=MetricConfig(eviction_strikes=2),
+    )
+    ch = Channel(1)
+    ms.subscribe_to_raw_metrics(ch)
+    ms.counter("c", 1)
+    ms.start()
+    try:
+        deadline = time.time() + WAIT
+        while not ch.closed and time.time() < deadline:
+            time.sleep(INTERVAL)
+        assert ch.closed, "slow subscriber was not evicted"
+        # the one delivered set is still readable, then ChannelClosed
+        ch.get(timeout=0.1)
+        with pytest.raises(ChannelClosed):
+            ch.get(timeout=0.1)
+    finally:
+        ms.stop()
+
+
+def test_healthy_subscriber_not_evicted():
+    ms = MetricSystem(interval=INTERVAL, sys_stats=False)
+    ch = Channel(4)
+    ms.subscribe_to_raw_metrics(ch)
+    ms.start()
+    try:
+        for _ in range(5):
+            _get(ch)
+        assert not ch.closed
+    finally:
+        ms.stop()
+
+
+def test_stop_cleans_up_threads():
+    # Leak test (reference TestMetricSystemStop, metrics_test.go:348-363).
+    baseline = threading.active_count()
+    ms = MetricSystem(interval=INTERVAL, sys_stats=False)
+    ms.start()
+    time.sleep(2 * INTERVAL)
+    started = threading.active_count()
+    assert started > baseline
+    ms.stop()
+    deadline = time.time() + WAIT
+    while threading.active_count() > baseline and time.time() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= baseline
+
+
+def test_start_idempotent():
+    ms = MetricSystem(interval=INTERVAL, sys_stats=False)
+    ms.start()
+    time.sleep(2 * INTERVAL)  # let the reaper spawn its worker pool
+    before = threading.active_count()
+    ms.start()  # second start must not spawn another reaper
+    time.sleep(2 * INTERVAL)
+    assert threading.active_count() == before
+    ms.stop()
+
+
+def test_immediate_stop_start():
+    # stop() joins the reaper, so a back-to-back restart must work.
+    ms = MetricSystem(interval=INTERVAL, sys_stats=False)
+    ch = Channel(16)
+    ms.subscribe_to_raw_metrics(ch)
+    ms.start()
+    _get(ch)
+    ms.stop()
+    ms.start()  # no sleep in between
+    try:
+        _get(ch)
+    finally:
+        ms.stop()
+
+
+def test_raising_gauge_does_not_kill_reaper():
+    ms = MetricSystem(interval=INTERVAL, sys_stats=False)
+
+    def bad_gauge():
+        raise RuntimeError("backend went away")
+
+    ms.register_gauge_func("db.conns", bad_gauge)
+    ms.register_gauge_func("ok", lambda: 42.0)
+    ch = Channel(16)
+    ms.subscribe_to_processed_metrics(ch)
+    ms.start()
+    try:
+        for _ in range(2):  # survives multiple ticks
+            m = _get(ch).metrics
+            assert m["ok"] == 42.0
+            assert "db.conns" not in m
+    finally:
+        ms.stop()
+
+
+def test_double_processing_does_not_double_count_aggregates():
+    ms = MetricSystem(interval=INTERVAL, sys_stats=False)
+    ms.histogram("h", 100)
+    raw = ms.collect_raw_metrics()
+    p1 = ms.process_metrics(raw)
+    p2 = ms.process_metrics(raw)  # processing is pure
+    ms._attach_aggregates(p1, raw)
+    ms._attach_aggregates(p2, raw)
+    assert p1.metrics["h_agg_count"] == 1
+    assert p2.metrics["h_agg_count"] == 1
+
+
+def test_restart_after_stop():
+    ms = MetricSystem(interval=INTERVAL, sys_stats=False)
+    ch = Channel(16)
+    ms.subscribe_to_raw_metrics(ch)
+    ms.start()
+    _get(ch)
+    ms.stop()
+    time.sleep(3 * INTERVAL)
+    ms.start()
+    try:
+        _get(ch)  # broadcasts resume
+    finally:
+        ms.stop()

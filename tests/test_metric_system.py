@@ -1,0 +1,183 @@
+"""Component tests calling collection/processing internals directly —
+mirrors reference metrics_test.go:174-240 (TestSysStats/TestTimer/TestRate/
+TestCounter) and the ExampleMetricSystem naming contract."""
+
+import time
+
+import pytest
+
+from loghisto_tpu import Channel, MetricConfig, MetricSystem
+
+
+def test_sys_stats():
+    ms = MetricSystem(interval=1e-6, sys_stats=True)
+    gauges = ms.collect_raw_metrics().gauges
+    assert gauges.get("sys.Alloc", 0) > 0
+    assert "sys.NumGC" in gauges
+    assert "sys.PauseTotalNs" in gauges
+    assert gauges.get("sys.NumGoroutine", 0) >= 1
+
+
+def test_timer():
+    ms = MetricSystem(interval=1e-6, sys_stats=False)
+    t1 = ms.start_timer("timer1")
+    t2 = ms.start_timer("timer1")
+    time.sleep(50e-6)
+    t1.stop()
+    time.sleep(5e-6)
+    t2.stop()
+    t3 = ms.start_timer("timer1")
+    time.sleep(10e-6)
+    dur = t3.stop()
+    assert dur >= 10_000  # ns
+    result = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert result["timer1_min"] <= result["timer1_50"] <= result["timer1_max"]
+    assert result["timer1_count"] == 3
+
+
+def test_timer_context_manager():
+    ms = MetricSystem(interval=1e-6, sys_stats=False)
+    with ms.start_timer("cm"):
+        time.sleep(1e-5)
+    result = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert result["cm_count"] == 1
+
+
+def test_rate_is_per_interval_delta():
+    ms = MetricSystem(interval=1e-6, sys_stats=False)
+    ms.counter("rate1", 777)
+    metrics = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert metrics["rate1_rate"] == 777
+    ms.counter("rate1", 1223)
+    metrics = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert metrics["rate1_rate"] == 1223
+    ms.counter("rate1", 1223)
+    ms.counter("rate1", 1223)
+    metrics = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert metrics["rate1_rate"] == 2446
+
+
+def test_counter_accumulates_across_collections():
+    ms = MetricSystem(interval=1e-6, sys_stats=False)
+    ms.counter("counter1", 3290)
+    metrics = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert metrics["counter1"] == 3290
+    ms.counter("counter1", 10000)
+    metrics = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert metrics["counter1"] == 13290
+    # rate for an interval with no new counts is absent (reference: rates
+    # include only this-interval names, counters include all lifetime names)
+    metrics = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert metrics["counter1"] == 13290
+    assert "counter1_rate" not in metrics
+
+
+def test_go_style_aliases():
+    ms = MetricSystem(interval=1e-6, sys_stats=False)
+    ms.Counter("c", 5)
+    ms.Histogram("h", 42.0)
+    token = ms.StartTimer("t")
+    token.Stop()
+    metrics = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert metrics["c"] == 5
+    assert metrics["h_count"] == 1
+
+
+def test_naming_scheme_end_to_end():
+    """ExampleMetricSystem analog (metrics_test.go:28-109): every derived
+    metric name from one record->collect->process cycle is present."""
+    import gc
+
+    ms = MetricSystem(interval=1e-6, sys_stats=True)
+    token = ms.start_timer("submit_metrics")
+    ms.counter("range_splits", 1)
+    ms.histogram("some_ipc_latency", 123)
+    token.stop()
+    gc.collect()  # ensure at least one tracked gc pause exists
+    raw = ms.collect_raw_metrics()
+    processed = ms.process_metrics(raw)
+    ms._attach_aggregates(processed, raw)
+    m = processed.metrics
+    for key in [
+        "range_splits",
+        "range_splits_rate",
+        "some_ipc_latency_99.9",
+        "some_ipc_latency_max",
+        "some_ipc_latency_min",
+        "some_ipc_latency_count",
+        "some_ipc_latency_agg_count",
+        "some_ipc_latency_sum",
+        "some_ipc_latency_avg",
+        "some_ipc_latency_agg_avg",
+        "submit_metrics_sum",
+        "sys.NumGoroutine",
+        "sys.PauseTotalNs",
+    ]:
+        assert m.get(key, 0) != 0, f"{key} missing or zero"
+
+
+def test_histogram_batch():
+    ms = MetricSystem(interval=1e-6, sys_stats=False)
+    ms.histogram_batch("b", [1.0, 2.0, 3.0, 4.0])
+    metrics = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert metrics["b_count"] == 4
+    assert abs(metrics["b_avg"] / 2.5 - 1) < 0.01
+
+
+def test_out_of_range_percentile_logged_and_skipped(caplog):
+    ms = MetricSystem(interval=1e-6, sys_stats=False)
+    ms.specify_percentiles({"%s_bogus": 1.5, "%s_50": 0.5})
+    ms.histogram("h", 10)
+    with caplog.at_level("ERROR", logger="loghisto_tpu"):
+        metrics = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert "h_bogus" not in metrics
+    assert "h_50" in metrics
+    assert any("percentile" in r.message for r in caplog.records)
+
+
+def test_agg_quirks_compat_mode():
+    # go_compat reproduces uint64 truncation + integer agg_avg division
+    # (reference metrics.go:374, 601-602).
+    for compat in (False, True):
+        ms = MetricSystem(
+            interval=1e-6, sys_stats=False,
+            config=MetricConfig(go_compat=compat),
+        )
+        for v in (33, 59, 330000):
+            ms.histogram("histogram1", v)
+        raw = ms.collect_raw_metrics()
+        processed = ms.process_metrics(raw)
+        ms._attach_aggregates(processed, raw)
+        m = processed.metrics
+        assert int(m["histogram1_sum"]) == 331132
+        assert int(m["histogram1_agg_avg"]) == 110377
+        if compat:
+            assert m["histogram1_agg_avg"] == 110377.0  # exact int division
+            assert m["histogram1_agg_sum"] == 331132.0
+
+
+def test_interval_floor():
+    ms = MetricSystem(interval=60.0, sys_stats=False)
+    ts = ms._interval_floor(now=123456789.5)
+    assert ts.timestamp() % 60.0 == 0.0
+    assert ts.timestamp() <= 123456789.5 < ts.timestamp() + 60.0
+
+
+def test_concurrent_ingest():
+    import threading
+
+    ms = MetricSystem(interval=1e-6, sys_stats=False)
+
+    def writer(n):
+        for i in range(1000):
+            ms.counter("c", 1)
+            ms.histogram("h", float(i % 100))
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    metrics = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert metrics["c"] == 8000
+    assert metrics["h_count"] == 8000
