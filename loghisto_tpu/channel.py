@@ -46,9 +46,13 @@ class Channel:
             return False
         try:
             self._q.put_nowait(item)
-            return True
         except queue.Full:
             return False
+        if self.closed:
+            # close() raced us: the item may sit behind the close sentinel
+            # and never be delivered, so don't claim acceptance.
+            return False
+        return True
 
     def get(self, block: bool = True, timeout: float | None = None) -> Any:
         """Blocking get; raises ChannelClosed once closed and drained,

@@ -63,6 +63,11 @@ class MetricConfig:
     bucket_limit: int = 4096
     eviction_strikes: int = 2
     go_compat: bool = False
+    # Raw histogram samples per metric buffered in a shard before being
+    # folded into sparse bucket counts at ingest time.  Bounds ingest-path
+    # memory to O(buckets) like the reference's per-call bucketing while
+    # keeping the batch-vectorized compression.
+    ingest_buffer_cap: int = 65536
 
     def __post_init__(self):
         if not 0 < self.bucket_limit <= 8192:

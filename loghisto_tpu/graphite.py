@@ -1,0 +1,39 @@
+"""Graphite plaintext-protocol serializer (reference layer L4).
+
+Wire format (reference graphite.go:40-47): one line per metric,
+
+    cockroach.<host>.<metric with _ -> .> <value> <unix_ts>\n
+
+The hardcoded ``cockroach.`` prefix is part of the reference's observed
+behavior; here it is the *default* of a configurable prefix (the reference
+has a TODO for custom tags/prefixes).  Values are rendered with ``%f``
+exactly like Go's ``fmt.Sprintf("%f")`` (six decimal places) so the wire
+bytes match.
+"""
+
+from __future__ import annotations
+
+import socket
+
+from loghisto_tpu.metrics import ProcessedMetricSet
+
+
+def graphite_protocol(
+    metric_set: ProcessedMetricSet,
+    prefix: str = "cockroach",
+    hostname: str | None = None,
+) -> bytes:
+    """Serialize a ProcessedMetricSet for a Graphite Carbon instance."""
+    if hostname is None:
+        hostname = socket.gethostname() or "unknown"
+    ts = int(metric_set.time.timestamp())
+    lines = [
+        "%s.%s.%s %f %d\n"
+        % (prefix, hostname, metric.replace("_", "."), value, ts)
+        for metric, value in metric_set.metrics.items()
+    ]
+    return "".join(lines).encode()
+
+
+# Reference-style alias: usable directly as a Submitter serializer.
+GraphiteProtocol = graphite_protocol

@@ -57,8 +57,8 @@ import numpy as np
 
 from loghisto_tpu.channel import Channel
 from loghisto_tpu.config import DEFAULT_PERCENTILES, MetricConfig
-from loghisto_tpu.ops.codec import compress_np, decompress_np
-from loghisto_tpu.ops.stats import percentiles_sparse
+from loghisto_tpu.ops.codec import compress_np
+from loghisto_tpu.ops.stats import percentiles_sparse, summarize_sparse
 from loghisto_tpu.utils.sysstats import default_gauges
 
 logger = logging.getLogger("loghisto_tpu")
@@ -117,14 +117,19 @@ class TimerToken:
 
 class _Shard:
     """One lock stripe of the ingest path: counter dict + histogram
-    append-buffers.  Threads hash to a shard; contention is 1/num_shards."""
+    append-buffers + folded sparse bucket counts.  Threads are assigned a
+    shard round-robin; contention is 1/num_shards.  When a metric's raw
+    buffer reaches the configured cap it is compressed and folded into
+    `bucket_counts`, bounding memory at O(buckets) regardless of sample
+    rate or whether the reaper is running."""
 
-    __slots__ = ("lock", "counters", "histograms")
+    __slots__ = ("lock", "counters", "histograms", "bucket_counts")
 
     def __init__(self):
         self.lock = threading.Lock()
         self.counters: Dict[str, int] = {}
         self.histograms: Dict[str, array] = {}
+        self.bucket_counts: Dict[str, Dict[int, int]] = {}
 
 
 def _num_default_shards() -> int:
@@ -196,13 +201,16 @@ class MetricSystem:
 
     def histogram(self, name: str, value: float) -> None:
         """Record one continuous value (metrics.go:273-295).  Values are
-        appended raw; log-bucketing happens vectorized at collection."""
+        appended raw; log-bucketing happens vectorized (at the buffer cap
+        or at collection, whichever comes first)."""
         shard = self._shard()
         with shard.lock:
             buf = shard.histograms.get(name)
             if buf is None:
                 buf = shard.histograms[name] = array("d")
             buf.append(value)
+            if len(buf) >= self.config.ingest_buffer_cap:
+                self._fold_shard_buffer(shard, name, buf)
 
     def histogram_batch(self, name: str, values) -> None:
         """Record many values of one metric in a single call — the natural
@@ -214,6 +222,20 @@ class MetricSystem:
             if buf is None:
                 buf = shard.histograms[name] = array("d")
             buf.extend(values)
+            if len(buf) >= self.config.ingest_buffer_cap:
+                self._fold_shard_buffer(shard, name, buf)
+
+    def _fold_shard_buffer(self, shard: _Shard, name: str, buf: array) -> None:
+        """Compress a full raw buffer into the shard's sparse bucket counts.
+        Caller holds shard.lock."""
+        values = np.frombuffer(buf, dtype=np.float64)
+        buckets = compress_np(values, self.config.precision)
+        uniq, cnt = np.unique(buckets, return_counts=True)
+        folded = shard.bucket_counts.setdefault(name, {})
+        for b, c in zip(uniq, cnt):
+            b = int(b)
+            folded[b] = folded.get(b, 0) + int(c)
+        shard.histograms[name] = array("d")
 
     def start_timer(self, name: str) -> TimerToken:
         """Begin a named timing; stop() the returned token (metrics.go:232)."""
@@ -308,14 +330,21 @@ class MetricSystem:
 
         fresh_counters: Dict[str, int] = {}
         hist_buffers: Dict[str, list] = {}
+        folded_counts: Dict[str, Dict[int, int]] = {}
         for shard in self._shards:
             with shard.lock:
                 counters, shard.counters = shard.counters, {}
                 hists, shard.histograms = shard.histograms, {}
+                folded, shard.bucket_counts = shard.bucket_counts, {}
             for name, amount in counters.items():
                 fresh_counters[name] = fresh_counters.get(name, 0) + amount
             for name, buf in hists.items():
-                hist_buffers.setdefault(name, []).append(buf)
+                if len(buf):
+                    hist_buffers.setdefault(name, []).append(buf)
+            for name, counts in folded.items():
+                dst = folded_counts.setdefault(name, {})
+                for b, c in counts.items():
+                    dst[b] = dst.get(b, 0) + c
 
         rates = dict(fresh_counters)
         with self._store_lock:
@@ -325,27 +354,35 @@ class MetricSystem:
                 )
             counters = dict(self._counter_store)
 
-        histograms: Dict[str, Dict[int, int]] = {}
+        histograms: Dict[str, Dict[int, int]] = folded_counts
         for name, bufs in hist_buffers.items():
             values = np.concatenate(
                 [np.frombuffer(b, dtype=np.float64) for b in bufs]
             ) if len(bufs) > 1 else np.frombuffer(bufs[0], dtype=np.float64)
             buckets = compress_np(values, self.config.precision)
             uniq, cnt = np.unique(buckets, return_counts=True)
-            histograms[name] = {
-                int(b): int(c) for b, c in zip(uniq, cnt)
-            }
-            # Fold this interval into the lifetime aggregate store HERE, at
-            # collection — exactly once per interval.  (The reference folds
-            # during processing, metrics.go:359-376, which double-counts if
-            # a RawMetricSet is processed twice and *under*-counts shed
-            # intervals; folding at collection fixes both.)  The folded sum
-            # is the decompressed-representative sum, like the reference's.
-            reps = decompress_np(uniq, self.config.precision)
-            total_sum = float(np.dot(reps, cnt.astype(np.float64)))
-            total_count = int(cnt.sum())
+            dst = histograms.setdefault(name, {})
+            for b, c in zip(uniq, cnt):
+                b = int(b)
+                dst[b] = dst.get(b, 0) + int(c)
+
+        # Fold this interval into the lifetime aggregate store HERE, at
+        # collection — exactly once per interval.  (The reference folds
+        # during processing, metrics.go:359-376, which double-counts if a
+        # RawMetricSet is processed twice and *under*-counts shed intervals;
+        # folding at collection fixes both.)  The folded sum is the
+        # decompressed-representative sum, like the reference's.
+        agg_increments = []
+        for name, bucket_counts in histograms.items():
+            buckets = np.fromiter(bucket_counts.keys(), dtype=np.int64)
+            cnt = np.fromiter(bucket_counts.values(), dtype=np.uint64)
+            total_sum, total_count = summarize_sparse(
+                buckets, cnt, self.config.precision
+            )
             sum_inc = int(total_sum) if self.config.go_compat else total_sum
-            with self._store_lock:
+            agg_increments.append((name, sum_inc, total_count))
+        with self._store_lock:
+            for name, sum_inc, total_count in agg_increments:
                 entry = self._histogram_agg_store.setdefault(name, [0, 0])
                 entry[0] += sum_inc
                 entry[1] += total_count
@@ -378,9 +415,9 @@ class MetricSystem:
         out: Dict[str, float] = {}
         buckets = np.fromiter(bucket_counts.keys(), dtype=np.int64)
         counts = np.fromiter(bucket_counts.values(), dtype=np.uint64)
-        values = decompress_np(buckets, self.config.precision)
-        total_sum = float(np.dot(values, counts.astype(np.float64)))
-        total_count = int(counts.sum())
+        total_sum, total_count = summarize_sparse(
+            buckets, counts, self.config.precision
+        )
 
         out[f"{name}_count"] = float(total_count)
         out[f"{name}_sum"] = total_sum
@@ -448,7 +485,7 @@ class MetricSystem:
         n_workers = max((os.cpu_count() or 4) // 4, 4)
         workers = [
             threading.Thread(
-                target=self._worker, args=(process_queue, shutdown),
+                target=self._worker, args=(process_queue,),
                 daemon=True, name="loghisto-worker",
             )
             for _ in range(n_workers)
@@ -456,17 +493,26 @@ class MetricSystem:
         for w in workers:
             w.start()
 
-        while True:
-            now = time.time()
-            tts = self.interval - (now % self.interval)
-            if shutdown.wait(timeout=tts):
-                return
-            try:
-                self._tick(process_queue)
-            except Exception:
-                # A failing collection/broadcast must not kill metric
-                # collection for the process lifetime.
-                logger.exception("reaper tick failed; continuing")
+        try:
+            while True:
+                now = time.time()
+                tts = self.interval - (now % self.interval)
+                if shutdown.wait(timeout=tts):
+                    return
+                try:
+                    self._tick(process_queue)
+                except Exception:
+                    # A failing collection/broadcast must not kill metric
+                    # collection for the process lifetime.
+                    logger.exception("reaper tick failed; continuing")
+        finally:
+            # Per-generation queue, so these sentinels can only ever reach
+            # this generation's workers.
+            for _ in workers:
+                try:
+                    process_queue.put(None, timeout=1.0)
+                except queue.Full:
+                    break  # workers are wedged; they are daemons anyway
 
     def _tick(self, process_queue: "queue.Queue") -> None:
         raw = self.collect_raw_metrics()
@@ -492,16 +538,11 @@ class MetricSystem:
                 raw.time,
             )
 
-    def _worker(
-        self, process_queue: "queue.Queue", shutdown: threading.Event
-    ) -> None:
+    def _worker(self, process_queue: "queue.Queue") -> None:
         while True:
-            try:
-                task = process_queue.get(timeout=0.1)
-            except queue.Empty:
-                if shutdown.is_set():
-                    return
-                continue
+            task = process_queue.get()
+            if task is None:
+                return
             try:
                 task()
             except Exception:

@@ -1,0 +1,43 @@
+"""OpenTSDB telnet-protocol serializer (reference layer L4).
+
+Wire format (reference opentsdb.go:45-55): one line per metric,
+
+    put <metric> <unix_ts> <value> <tag>=<value> ...\n
+
+with a ``host=<hostname>`` tag by default.  Values use ``%f`` to match the
+reference's wire bytes.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Mapping
+
+from loghisto_tpu.metrics import ProcessedMetricSet
+
+
+def _tags_to_wire(tags: Mapping[str, str]) -> str:
+    return " ".join(f"{tag}={value}" for tag, value in tags.items())
+
+
+def opentsdb_protocol(
+    metric_set: ProcessedMetricSet,
+    tags: Mapping[str, str] | None = None,
+    hostname: str | None = None,
+) -> bytes:
+    """Serialize a ProcessedMetricSet for an OpenTSDB/KairosDB instance."""
+    if hostname is None:
+        hostname = socket.gethostname() or "unknown"
+    if tags is None:
+        tags = {"host": hostname}
+    ts = int(metric_set.time.timestamp())
+    wire_tags = _tags_to_wire(tags)
+    lines = [
+        "put %s %d %f %s\n" % (metric, ts, value, wire_tags)
+        for metric, value in metric_set.metrics.items()
+    ]
+    return "".join(lines).encode()
+
+
+# Reference-style alias: usable directly as a Submitter serializer.
+OpenTSDBProtocol = opentsdb_protocol

@@ -1,0 +1,169 @@
+"""Submitter: ships serialized metric sets to a TSDB over TCP (layer L4).
+
+Reference semantics (submitter.go:33-159) preserved:
+  * subscribes to processed metrics behind the subscription boundary;
+  * an evicting ring backlog of 60 slots (the oldest request is dropped
+    when the ring wraps) so a dead TSDB cannot grow memory unboundedly;
+  * a sender loop that wakes on interval boundaries and drains the backlog
+    head-first, stopping at the first failure;
+  * each send is a fresh dial with 5s connect/write timeouts — delivery is
+    best-effort, at-most-once, unacknowledged.
+
+Redesigned details: one sender thread (the reference uses two goroutines —
+receive/serialize and retry — we serialize on receipt in the receiver
+thread and retry in the sender thread, same observable behavior), and the
+ring is a deque with maxlen which has identical evict-oldest semantics.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from loghisto_tpu.channel import Channel, ChannelClosed
+from loghisto_tpu.metrics import MetricSystem, ProcessedMetricSet
+
+logger = logging.getLogger("loghisto_tpu")
+
+BACKLOG_SLOTS = 60
+DIAL_TIMEOUT_S = 5.0
+
+
+class Submitter:
+    """Receives processed metric sets, serializes them, and attempts
+    delivery to `destination_address` with retry from an evicting backlog."""
+
+    def __init__(
+        self,
+        metric_system: MetricSystem,
+        serializer: Callable[[ProcessedMetricSet], bytes],
+        destination_network: str,
+        destination_address: tuple[str, int],
+        backlog_slots: int = BACKLOG_SLOTS,
+        dial_timeout: float = DIAL_TIMEOUT_S,
+    ):
+        if destination_network not in ("tcp", "udp"):
+            raise ValueError("destination_network must be 'tcp' or 'udp'")
+        self.metric_system = metric_system
+        self.serializer = serializer
+        self.destination_network = destination_network
+        self.destination_address = destination_address
+        self.dial_timeout = dial_timeout
+        self._backlog: deque[bytes] = deque(maxlen=backlog_slots)
+        self._backlog_lock = threading.Lock()
+        self._metric_chan = Channel(backlog_slots)
+        self._shutdown = threading.Event()
+        self._threads: list[threading.Thread] = []
+        metric_system.subscribe_to_processed_metrics(self._metric_chan)
+
+    # -- backlog ------------------------------------------------------- #
+
+    def _append_to_backlog(self, request: bytes) -> None:
+        with self._backlog_lock:
+            self._backlog.append(request)  # maxlen evicts the oldest
+
+    def retry_backlog(self) -> Optional[Exception]:
+        """Drain the backlog head-first; stop at the first failure and
+        keep the unsent tail (reference submitter.go:70-93)."""
+        while True:
+            with self._backlog_lock:
+                if not self._backlog:
+                    return None
+                request = self._backlog[0]
+            err = self.submit(request)
+            if err is not None:
+                return err
+            with self._backlog_lock:
+                if self._backlog and self._backlog[0] is request:
+                    self._backlog.popleft()
+
+    # -- wire ---------------------------------------------------------- #
+
+    def submit(self, request: bytes) -> Optional[Exception]:
+        """One best-effort delivery: fresh dial, write, close
+        (reference submitter.go:106-116).  Returns the error, if any."""
+        sock_type = (
+            socket.SOCK_STREAM if self.destination_network == "tcp"
+            else socket.SOCK_DGRAM
+        )
+        try:
+            sock = socket.socket(socket.AF_INET, sock_type)
+            sock.settimeout(self.dial_timeout)
+            try:
+                sock.connect(self.destination_address)
+                sock.sendall(request)
+            finally:
+                sock.close()
+            return None
+        except OSError as e:
+            return e
+
+    # -- lifecycle ------------------------------------------------------ #
+
+    def _receiver_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                metrics = self._metric_chan.get(timeout=0.1)
+            except ChannelClosed:
+                return  # evicted by the MetricSystem: no more progress
+            except Exception:
+                continue
+            try:
+                self._append_to_backlog(self.serializer(metrics))
+            except Exception:
+                logger.exception("serializer failed; dropping metric set")
+
+    def _sender_loop(self) -> None:
+        interval = self.metric_system.interval
+        while not self._shutdown.is_set():
+            err = self.retry_backlog()
+            if err is not None:
+                logger.debug("metric submission failed: %s", err)
+            tts = interval - (time.time() % interval)
+            self._shutdown.wait(timeout=tts)
+
+    def start(self) -> None:
+        """Spawn the receive/serialize and send/retry threads
+        (reference submitter.go:119-149)."""
+        if self._threads:
+            return
+        self._threads = [
+            threading.Thread(
+                target=self._receiver_loop, daemon=True,
+                name="loghisto-submitter-recv",
+            ),
+            threading.Thread(
+                target=self._sender_loop, daemon=True,
+                name="loghisto-submitter-send",
+            ),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def shutdown(self) -> None:
+        """Stop both threads; idempotent (reference submitter.go:152-159)."""
+        self._shutdown.set()
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=5.0)
+        self._threads = []
+
+    # Reference-style aliases.
+    Start = start
+    Shutdown = shutdown
+
+
+def new_submitter(
+    metric_system: MetricSystem,
+    serializer: Callable[[ProcessedMetricSet], bytes],
+    destination_network: str,
+    destination_address: tuple[str, int],
+) -> Submitter:
+    """Constructor mirroring the reference's NewSubmitter signature."""
+    return Submitter(
+        metric_system, serializer, destination_network, destination_address
+    )

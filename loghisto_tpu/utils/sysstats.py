@@ -23,7 +23,10 @@ import threading
 import time
 from typing import Callable, Dict
 
-_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE")
+try:
+    _PAGE_SIZE = os.sysconf("SC_PAGE_SIZE")
+except (AttributeError, ValueError, OSError):
+    _PAGE_SIZE = 4096
 
 
 def rss_bytes() -> float:

@@ -136,13 +136,17 @@ def test_stop_cleans_up_threads():
 
 
 def test_start_idempotent():
+    def reaper_count():
+        return sum(
+            1 for t in threading.enumerate() if t.name == "loghisto-reaper"
+        )
+
+    base = reaper_count()
     ms = MetricSystem(interval=INTERVAL, sys_stats=False)
     ms.start()
-    time.sleep(2 * INTERVAL)  # let the reaper spawn its worker pool
-    before = threading.active_count()
     ms.start()  # second start must not spawn another reaper
     time.sleep(2 * INTERVAL)
-    assert threading.active_count() == before
+    assert reaper_count() == base + 1
     ms.stop()
 
 

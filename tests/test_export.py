@@ -1,0 +1,163 @@
+"""Export-layer tests: byte-exact serializers and a Submitter driven
+against a real in-test TCP listener — deliberately stronger than the
+reference's smoke tests, which submit toward a dead port and ignore the
+error (graphite_test.go:8-23, opentsdb_test.go:8-23; SURVEY.md §4.5)."""
+
+import datetime as dt
+import socket
+import socketserver
+import threading
+import time
+
+import pytest
+
+from loghisto_tpu import MetricSystem, ProcessedMetricSet
+from loghisto_tpu.graphite import graphite_protocol
+from loghisto_tpu.opentsdb import opentsdb_protocol
+from loghisto_tpu.submitter import Submitter, new_submitter
+
+TS = dt.datetime(2026, 1, 2, 3, 4, 5, tzinfo=dt.timezone.utc)
+
+
+def _pms(metrics):
+    return ProcessedMetricSet(time=TS, metrics=metrics)
+
+
+def test_graphite_wire_format():
+    out = graphite_protocol(
+        _pms({"put_latency_99.9": 45.2}), hostname="testhost"
+    )
+    ts = int(TS.timestamp())
+    assert out == f"cockroach.testhost.put.latency.99.9 45.200000 {ts}\n".encode()
+
+
+def test_graphite_multiple_lines_and_prefix():
+    out = graphite_protocol(
+        _pms({"a_b": 1.0, "c": 2.5}), prefix="myapp", hostname="h"
+    )
+    lines = out.decode().splitlines()
+    assert len(lines) == 2
+    assert all(line.startswith("myapp.h.") for line in lines)
+
+
+def test_opentsdb_wire_format():
+    out = opentsdb_protocol(_pms({"put_latency_99.9": 45.2}), hostname="th")
+    ts = int(TS.timestamp())
+    assert out == f"put put_latency_99.9 {ts} 45.200000 host=th\n".encode()
+
+
+def test_opentsdb_custom_tags():
+    out = opentsdb_protocol(
+        _pms({"m": 1.0}), tags={"host": "h1", "dc": "us-east"}
+    )
+    assert out.decode().rstrip("\n").endswith("host=h1 dc=us-east")
+
+
+class _Collector(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self):
+        self.received: list[bytes] = []
+        self.lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                data = self.rfile.read()
+                with outer.lock:
+                    outer.received.append(data)
+
+        super().__init__(("127.0.0.1", 0), Handler)
+
+
+@pytest.fixture
+def collector():
+    server = _Collector()
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield server
+    server.shutdown()
+    server.server_close()
+
+
+def test_submitter_delivers_to_real_listener(collector):
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    sub = new_submitter(
+        ms, graphite_protocol, "tcp", collector.server_address
+    )
+    ms.counter("reqs", 42)
+    ms.start()
+    sub.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            with collector.lock:
+                if collector.received:
+                    break
+            time.sleep(0.02)
+        with collector.lock:
+            assert collector.received, "nothing delivered"
+            payload = b"".join(collector.received).decode()
+        assert "reqs" in payload
+        assert ".reqs.rate " in payload or ".reqs " in payload
+    finally:
+        sub.shutdown()
+        ms.stop()
+
+
+def test_submitter_backlog_retry_after_outage():
+    # Destination starts dead; requests accumulate in the backlog; when a
+    # listener appears, the backlog drains head-first.
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    addr = probe.getsockname()
+    probe.close()  # port now dead
+
+    sub = Submitter(ms, graphite_protocol, "tcp", addr, dial_timeout=0.2)
+    sub._append_to_backlog(b"first\n")
+    sub._append_to_backlog(b"second\n")
+    err = sub.retry_backlog()
+    assert err is not None  # dead destination reported
+    assert len(sub._backlog) == 2  # nothing lost
+
+    server = _Collector()
+    sub.destination_address = server.server_address
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        err = sub.retry_backlog()
+        assert err is None
+        assert len(sub._backlog) == 0
+        time.sleep(0.2)
+        with server.lock:
+            assert b"first\n" in server.received
+            assert b"second\n" in server.received
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_backlog_evicts_oldest_when_full():
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    sub = Submitter(
+        ms, graphite_protocol, "tcp", ("127.0.0.1", 1), backlog_slots=3
+    )
+    for i in range(5):
+        sub._append_to_backlog(f"req{i}".encode())
+    assert list(sub._backlog) == [b"req2", b"req3", b"req4"]
+
+
+def test_submitter_rejects_bad_network():
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    with pytest.raises(ValueError):
+        Submitter(ms, graphite_protocol, "carrier-pigeon", ("h", 1))
+
+
+def test_submitter_shutdown_idempotent(collector):
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    sub = new_submitter(ms, graphite_protocol, "tcp", collector.server_address)
+    sub.start()
+    sub.shutdown()
+    sub.shutdown()  # second shutdown is a no-op

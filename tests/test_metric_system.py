@@ -124,6 +124,24 @@ def test_histogram_batch():
     assert abs(metrics["b_avg"] / 2.5 - 1) < 0.01
 
 
+def test_ingest_time_fold_bounds_memory():
+    # With a tiny buffer cap, raw samples fold into sparse bucket counts at
+    # ingest; totals survive exactly and raw buffers stay bounded even
+    # without a running reaper.
+    ms = MetricSystem(
+        interval=1e-6, sys_stats=False,
+        config=MetricConfig(ingest_buffer_cap=100),
+    )
+    for i in range(1005):
+        ms.histogram("h", float(i % 7 + 1))
+    raw_buffered = sum(
+        len(buf) for s in ms._shards for buf in s.histograms.values()
+    )
+    assert raw_buffered < 100  # everything past the cap was folded
+    metrics = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert metrics["h_count"] == 1005
+
+
 def test_out_of_range_percentile_logged_and_skipped(caplog):
     ms = MetricSystem(interval=1e-6, sys_stats=False)
     ms.specify_percentiles({"%s_bogus": 1.5, "%s_50": 0.5})
