@@ -1,0 +1,112 @@
+"""Headline benchmark: histogram ingest+aggregation throughput at 10k
+metrics on one chip (BASELINE.json: "histogram samples/sec/chip at 10k
+metrics; p99 percentile-query latency").
+
+Workload: batches of (metric_id, value) samples, Zipf-skewed across 10k
+metric names (BASELINE.json configs[1]), pushed through the fused
+compress -> scatter-add ingest into the dense int32[10k, 8193] bucket
+tensor, with a full statistics extraction (counts/sums/9 percentiles — the
+PrintBenchmark percentile set) once per simulated interval.  Batches are
+pre-staged on device: the measured path is the aggregation kernel, the
+host->device transfer story is measured separately by the firehose bench
+(future work, SURVEY.md §7 hard part (a)).
+
+Baseline: the Go reference demonstrates ~2.017e7 samples/s/process through
+its hot path (readme.md:27,34; BASELINE.md) — vs_baseline is against that.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_SAMPLES_PER_S = 2.017e7
+
+NUM_METRICS = 10_000
+BUCKET_LIMIT = 4_096
+BATCH = 1 << 22  # 4.2M samples per step
+STEPS = 16
+STATS_EVERY = 8  # one stats extraction per 8 ingest steps ("interval")
+
+
+def zipf_ids(rng: np.random.Generator, n: int, m: int) -> np.ndarray:
+    """Zipf-skewed metric ids in [0, m): a few hot metrics, long tail."""
+    raw = rng.zipf(1.3, size=n)
+    return ((raw - 1) % m).astype(np.int32)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from loghisto_tpu.config import MetricConfig
+    from loghisto_tpu.ops.ingest import ingest_batch
+    from loghisto_tpu.ops.stats import dense_stats
+
+    cfg = MetricConfig(bucket_limit=BUCKET_LIMIT)
+    ps = np.array(
+        [0.0, 0.5, 0.75, 0.9, 0.95, 0.99, 0.999, 0.9999, 1.0],
+        dtype=np.float32,
+    )
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+
+    @jax.jit
+    def ingest(acc, ids, values):
+        return ingest_batch(acc, ids, values, cfg.bucket_limit, cfg.precision)
+
+    @jax.jit
+    def stats(acc):
+        return dense_stats(acc, ps, cfg.bucket_limit, cfg.precision)
+
+    rng = np.random.default_rng(0)
+    ids = jax.device_put(zipf_ids(rng, BATCH, NUM_METRICS))
+    values = jax.device_put(
+        rng.lognormal(mean=10.0, sigma=2.0, size=BATCH).astype(np.float32)
+    )
+    acc = jnp.zeros((NUM_METRICS, cfg.num_buckets), dtype=jnp.int32)
+
+    # warmup / compile
+    acc = ingest(acc, ids, values)
+    s = stats(acc)
+    jax.block_until_ready((acc, s))
+
+    # timed ingest steps with periodic stats extraction
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        acc = ingest(acc, ids, values)
+        if (i + 1) % STATS_EVERY == 0:
+            s = stats(acc)
+    jax.block_until_ready((acc, s))
+    elapsed = time.perf_counter() - t0
+    samples_per_s = BATCH * STEPS / elapsed
+
+    # percentile-query latency: one full stats extraction, steady state
+    lat = []
+    for _ in range(20):
+        t1 = time.perf_counter()
+        jax.block_until_ready(stats(acc))
+        lat.append(time.perf_counter() - t1)
+    p99_query_us = float(np.percentile(lat, 99) * 1e6)
+
+    print(json.dumps({
+        "metric": "histogram samples/sec/chip at 10k metrics",
+        "value": round(samples_per_s, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(samples_per_s / BASELINE_SAMPLES_PER_S, 3),
+        "percentile_query_p99_us": round(p99_query_us, 1),
+        "platform": platform,
+        "batch": BATCH,
+        "steps": STEPS,
+        "num_metrics": NUM_METRICS,
+        "num_buckets": cfg.num_buckets,
+    }))
+
+
+if __name__ == "__main__":
+    main()

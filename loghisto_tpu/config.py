@@ -79,6 +79,16 @@ class MetricConfig:
             )
         if self.precision <= 0:
             raise ValueError(f"precision must be positive, got {self.precision}")
+        if self.ingest_buffer_cap < 64:
+            # below this the per-sample fold overhead dominates the hot path
+            raise ValueError(
+                "ingest_buffer_cap must be >= 64, got "
+                f"{self.ingest_buffer_cap}"
+            )
+        if self.eviction_strikes < 1:
+            raise ValueError(
+                f"eviction_strikes must be >= 1, got {self.eviction_strikes}"
+            )
 
     @property
     def num_buckets(self) -> int:

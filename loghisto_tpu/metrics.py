@@ -136,6 +136,13 @@ def _num_default_shards() -> int:
     return max(4, min(64, (os.cpu_count() or 4)))
 
 
+def _merge_counts(dst: Dict[int, int], buckets, counts) -> None:
+    """Fold (bucket, count) pairs into a sparse bucket->count dict."""
+    for b, c in zip(buckets, counts):
+        b = int(b)
+        dst[b] = dst.get(b, 0) + int(c)
+
+
 class MetricSystem:
     """Collects and distributes metrics (rebuild of reference
     metrics.go:79-195)."""
@@ -231,10 +238,7 @@ class MetricSystem:
         values = np.frombuffer(buf, dtype=np.float64)
         buckets = compress_np(values, self.config.precision)
         uniq, cnt = np.unique(buckets, return_counts=True)
-        folded = shard.bucket_counts.setdefault(name, {})
-        for b, c in zip(uniq, cnt):
-            b = int(b)
-            folded[b] = folded.get(b, 0) + int(c)
+        _merge_counts(shard.bucket_counts.setdefault(name, {}), uniq, cnt)
         shard.histograms[name] = array("d")
 
     def start_timer(self, name: str) -> TimerToken:
@@ -342,9 +346,10 @@ class MetricSystem:
                 if len(buf):
                     hist_buffers.setdefault(name, []).append(buf)
             for name, counts in folded.items():
-                dst = folded_counts.setdefault(name, {})
-                for b, c in counts.items():
-                    dst[b] = dst.get(b, 0) + c
+                _merge_counts(
+                    folded_counts.setdefault(name, {}),
+                    counts.keys(), counts.values(),
+                )
 
         rates = dict(fresh_counters)
         with self._store_lock:
@@ -361,10 +366,7 @@ class MetricSystem:
             ) if len(bufs) > 1 else np.frombuffer(bufs[0], dtype=np.float64)
             buckets = compress_np(values, self.config.precision)
             uniq, cnt = np.unique(buckets, return_counts=True)
-            dst = histograms.setdefault(name, {})
-            for b, c in zip(uniq, cnt):
-                b = int(b)
-                dst[b] = dst.get(b, 0) + int(c)
+            _merge_counts(histograms.setdefault(name, {}), uniq, cnt)
 
         # Fold this interval into the lifetime aggregate store HERE, at
         # collection — exactly once per interval.  (The reference folds
@@ -485,7 +487,7 @@ class MetricSystem:
         n_workers = max((os.cpu_count() or 4) // 4, 4)
         workers = [
             threading.Thread(
-                target=self._worker, args=(process_queue,),
+                target=self._worker, args=(process_queue, shutdown),
                 daemon=True, name="loghisto-worker",
             )
             for _ in range(n_workers)
@@ -538,9 +540,19 @@ class MetricSystem:
                 raw.time,
             )
 
-    def _worker(self, process_queue: "queue.Queue") -> None:
+    def _worker(
+        self, process_queue: "queue.Queue", shutdown: threading.Event
+    ) -> None:
+        # Exit on a None sentinel (prompt path) OR on shutdown+idle (the
+        # guaranteed path: sentinel delivery is best-effort when the queue
+        # is saturated at stop time, and workers must not leak).
         while True:
-            task = process_queue.get()
+            try:
+                task = process_queue.get(timeout=0.5)
+            except queue.Empty:
+                if shutdown.is_set():
+                    return
+                continue
             if task is None:
                 return
             try:

@@ -1,0 +1,350 @@
+"""TPU aggregation engine: dense device accumulators, distributed merges,
+and the TPUAggregator runtime that gates them behind the subscription
+boundary.
+
+North-star architecture (BASELINE.json): host callers keep using
+``MetricSystem``; a TPUAggregator ships raw samples (or pre-bucketed
+interval histograms) to the device, where
+
+  * ingest is a fused compress -> scatter-add (ops/ingest.py),
+  * cross-stream / cross-host merge is a ``psum`` over the mesh's stream
+    axis — the elementwise-additive merge the log-bucket representation
+    makes exact,
+  * percentile extraction is the CDF scan of ops/stats.py, row-parallel
+    over the metric axis.
+
+The distributed step below runs under ``shard_map`` on a
+("stream", "metric") mesh: sample shards enter per device, local dense
+histograms are psum-merged across the stream axis, folded into the
+metric-sharded accumulator, and per-metric statistics come back sharded by
+metric rows.  This is the §5.7/§5.8 slot of SURVEY.md — the capability the
+reference (a single-process Go library) does not have.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Dict, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from loghisto_tpu.config import DEFAULT_PERCENTILES, MetricConfig
+from loghisto_tpu.metrics import MetricSystem, ProcessedMetricSet, RawMetricSet
+from loghisto_tpu.channel import Channel, ChannelClosed
+from loghisto_tpu.ops.ingest import (
+    bucket_indices,
+    make_ingest_fn,
+    make_weighted_ingest_fn,
+    sanitize_ids,
+)
+from loghisto_tpu.ops.stats import dense_stats
+from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
+from loghisto_tpu.registry import MetricRegistry
+
+
+def make_distributed_step(
+    mesh: Mesh,
+    num_metrics: int,
+    bucket_limit: int,
+    percentile_values,
+    precision: int = 100,
+):
+    """Build the jitted full aggregation step over a ("stream", "metric")
+    mesh.
+
+    Returns f(acc, ids, values) -> (new_acc, stats) where
+      acc    int32 [num_metrics, num_buckets], sharded over metric rows
+      ids    int32 [N], sharded over the stream axis
+      values float32 [N], sharded over the stream axis
+      stats  {"counts": [M] (metric-sharded), "sums": [M],
+              "percentiles": [M, P]}
+
+    Per device: bucket the local sample shard into a local dense histogram
+    (dropping ids outside this device's metric rows), psum across the
+    stream axis, fold into the accumulator, then extract statistics for
+    the local metric rows.  All collectives are XLA-native and ride ICI.
+    """
+    n_metric = mesh.shape[METRIC_AXIS]
+    if num_metrics % n_metric:
+        raise ValueError(
+            f"num_metrics={num_metrics} not divisible by metric axis "
+            f"size {n_metric}"
+        )
+    rows_per_shard = num_metrics // n_metric
+    ps = jnp.asarray(percentile_values, dtype=jnp.float32)
+
+    def local_step(acc_local, ids, values):
+        shard = jax.lax.axis_index(METRIC_AXIS)
+        # ids below this shard's range go negative; sanitize so drop-mode
+        # really drops them instead of wrapping to the last row.
+        local_ids = sanitize_ids(ids - shard * rows_per_shard)
+        bidx = bucket_indices(values, bucket_limit, precision)
+        hist = jnp.zeros_like(acc_local).at[local_ids, bidx].add(
+            1, mode="drop"
+        )
+        hist = jax.lax.psum(hist, STREAM_AXIS)
+        acc_local = acc_local + hist
+        stats = dense_stats(acc_local, ps, bucket_limit, precision)
+        return acc_local, stats
+
+    stats_specs = {
+        "counts": P(METRIC_AXIS),
+        "sums": P(METRIC_AXIS),
+        "percentiles": P(METRIC_AXIS, None),
+    }
+    step = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(METRIC_AXIS, None), P(STREAM_AXIS), P(STREAM_AXIS)),
+        out_specs=(P(METRIC_AXIS, None), stats_specs),
+    )
+    return jax.jit(step, donate_argnums=0)
+
+
+def make_sharded_accumulator(
+    mesh: Mesh, num_metrics: int, num_buckets: int
+) -> jnp.ndarray:
+    """Zero accumulator laid out metric-sharded, stream-replicated."""
+    sharding = NamedSharding(mesh, P(METRIC_AXIS, None))
+    return jax.device_put(
+        jnp.zeros((num_metrics, num_buckets), dtype=jnp.int32), sharding
+    )
+
+
+class TPUAggregator:
+    """Device-tier metric engine (the reference has no equivalent; this is
+    the TPU execution backend the north star adds behind the subscription
+    boundary).
+
+    Two ways in:
+      * `record_batch(ids, values)` / `record(name, value)` — direct
+        firehose ingestion; batches buffer on host and flush to the device
+        as fused compress+scatter-add steps.
+      * `attach(metric_system)` — subscribe to the host MetricSystem's raw
+        broadcast and merge each interval's pre-bucketed histograms into
+        the device accumulator (weighted scatter-add), so existing callers
+        get device-side percentile extraction without code changes.
+
+    `collect()` extracts all statistics on device (one CDF-scan program),
+    resets the accumulator, folds lifetime aggregates on host (python ints
+    — immune to int32 overflow across intervals), and returns a
+    ProcessedMetricSet with the standard naming scheme.
+    """
+
+    def __init__(
+        self,
+        num_metrics: int = 1024,
+        config: MetricConfig = MetricConfig(),
+        percentiles: Mapping[str, float] = DEFAULT_PERCENTILES,
+        registry: Optional[MetricRegistry] = None,
+        batch_size: int = 1 << 16,
+    ):
+        self.config = config
+        self.num_metrics = num_metrics
+        self.registry = registry or MetricRegistry(capacity=num_metrics)
+        self.percentiles = dict(percentiles)
+        self.batch_size = batch_size
+
+        self._lock = threading.Lock()
+        self._pending_ids: list[np.ndarray] = []
+        self._pending_values: list[np.ndarray] = []
+        self._pending_count = 0
+
+        self._acc = jnp.zeros(
+            (num_metrics, config.num_buckets), dtype=jnp.int32
+        )
+        self._ingest = make_ingest_fn(config.bucket_limit, config.precision)
+        self._weighted_ingest = make_weighted_ingest_fn(
+            config.bucket_limit, config.precision
+        )
+        self._stats_fn = jax.jit(
+            functools.partial(
+                dense_stats,
+                bucket_limit=config.bucket_limit,
+                precision=config.precision,
+            )
+        )
+        # lifetime aggregates on host: name id -> [sum, count]
+        self._agg_lock = threading.Lock()
+        self._agg: Dict[int, list] = {}
+        self._last_aggregation_us = 0.0
+
+        self._attached: Optional[tuple[MetricSystem, Channel, threading.Thread]] = None
+
+    # -- direct ingestion ---------------------------------------------- #
+
+    def record(self, name: str, value: float) -> None:
+        self.record_batch(
+            np.array([self.registry.id_for(name)], dtype=np.int32),
+            np.array([value], dtype=np.float32),
+        )
+
+    def record_batch(self, ids: np.ndarray, values: np.ndarray) -> None:
+        """Buffer a batch of (metric_id, value) samples; flushes to device
+        when the buffered count reaches batch_size."""
+        ids = np.asarray(ids, dtype=np.int32)
+        values = np.asarray(values, dtype=np.float32)
+        if ids.shape != values.shape:
+            raise ValueError("ids and values must have the same shape")
+        with self._lock:
+            self._pending_ids.append(ids)
+            self._pending_values.append(values)
+            self._pending_count += len(ids)
+            should_flush = self._pending_count >= self.batch_size
+        if should_flush:
+            self.flush()
+
+    def flush(self) -> None:
+        """Push buffered samples to the device accumulator."""
+        with self._lock:
+            if not self._pending_count:
+                return
+            ids = np.concatenate(self._pending_ids)
+            values = np.concatenate(self._pending_values)
+            self._pending_ids, self._pending_values = [], []
+            self._pending_count = 0
+            self._acc = self._ingest(self._acc, ids, values)
+
+    # -- host-tier bridge ----------------------------------------------- #
+
+    def merge_raw(self, raw: RawMetricSet) -> None:
+        """Merge one host-tier interval (sparse bucket maps) into the dense
+        device accumulator via a weighted scatter-add."""
+        ids, bidx, weights = [], [], []
+        limit = self.config.bucket_limit
+        for name, bucket_counts in raw.histograms.items():
+            mid = self.registry.id_for(name)
+            for bucket, count in bucket_counts.items():
+                ids.append(mid)
+                bidx.append(min(max(bucket, -limit), limit) + limit)
+                weights.append(count)
+        if not ids:
+            return
+        with self._lock:
+            self._acc = self._weighted_ingest(
+                self._acc,
+                np.asarray(ids, dtype=np.int32),
+                np.asarray(bidx, dtype=np.int32),
+                np.asarray(weights, dtype=np.int32),
+            )
+
+    def attach(self, ms: MetricSystem, channel_capacity: int = 8) -> None:
+        """Subscribe to a MetricSystem's raw broadcast; every interval's
+        histograms are merged into the device accumulator on a bridge
+        thread (the subscription boundary of the north star)."""
+        if self._attached is not None:
+            raise RuntimeError("already attached")
+        ch = Channel(channel_capacity)
+        ms.subscribe_to_raw_metrics(ch)
+
+        def bridge():
+            while True:
+                try:
+                    raw = ch.get()
+                except ChannelClosed:
+                    return
+                try:
+                    self.merge_raw(raw)
+                except Exception:  # pragma: no cover - defensive
+                    import logging
+
+                    logging.getLogger("loghisto_tpu").exception(
+                        "device merge failed for interval %s", raw.time
+                    )
+
+        t = threading.Thread(
+            target=bridge, daemon=True, name="loghisto-tpu-bridge"
+        )
+        t.start()
+        self._attached = (ms, ch, t)
+
+    def detach(self) -> None:
+        if self._attached is None:
+            return
+        ms, ch, t = self._attached
+        ms.unsubscribe_from_raw_metrics(ch)
+        ch.close()
+        t.join(timeout=5.0)
+        self._attached = None
+
+    # -- collection ----------------------------------------------------- #
+
+    def collect(self, reset: bool = True) -> ProcessedMetricSet:
+        """Extract statistics for every registered metric on device and
+        return them with the standard naming scheme."""
+        self.flush()
+        labels, ps = [], []
+        for label, p in self.percentiles.items():
+            if 0.0 <= p <= 1.0:
+                labels.append(label)
+                ps.append(p)
+        t0 = time.perf_counter()
+        with self._lock:
+            acc = self._acc
+            stats = self._stats_fn(acc, np.asarray(ps, dtype=np.float32))
+            counts = np.asarray(stats["counts"])
+            sums = np.asarray(stats["sums"])
+            pcts = np.asarray(stats["percentiles"])
+            if reset:
+                self._acc = jnp.zeros_like(acc)
+        self._last_aggregation_us = (time.perf_counter() - t0) * 1e6
+
+        names = self.registry.names()
+        metrics: Dict[str, float] = {}
+        with self._agg_lock:
+            for mid, name in enumerate(names):
+                count = int(counts[mid])
+                if count == 0:
+                    continue
+                total = float(sums[mid])
+                metrics[f"{name}_count"] = float(count)
+                metrics[f"{name}_sum"] = total
+                metrics[f"{name}_avg"] = total / count
+                for label, value in zip(labels, pcts[mid]):
+                    metrics[label % name] = float(value)
+                entry = self._agg.setdefault(mid, [0.0, 0])
+                if self.config.go_compat:
+                    entry[0] += int(total)
+                else:
+                    entry[0] += total
+                entry[1] += count
+            for mid, entry in self._agg.items():
+                name = names[mid] if mid < len(names) else None
+                if name is None or entry[1] <= 0:
+                    continue
+                if self.config.go_compat:
+                    avg = float(int(entry[0]) // int(entry[1]))
+                else:
+                    avg = entry[0] / entry[1]
+                metrics[f"{name}_agg_avg"] = avg
+                metrics[f"{name}_agg_count"] = float(entry[1])
+                metrics[f"{name}_agg_sum"] = float(entry[0])
+
+        import datetime as _dt
+
+        return ProcessedMetricSet(
+            time=_dt.datetime.now(tz=_dt.timezone.utc), metrics=metrics
+        )
+
+    # -- gauges ---------------------------------------------------------- #
+
+    def register_device_gauges(self, ms: MetricSystem) -> None:
+        """Register TPU gauges on a MetricSystem: HBM use and the last
+        device aggregation time (SURVEY.md §5.5)."""
+
+        def hbm_bytes() -> float:
+            try:
+                stats = jax.devices()[0].memory_stats()
+                return float((stats or {}).get("bytes_in_use", 0))
+            except Exception:
+                return 0.0
+
+        ms.register_gauge_func("tpu.HbmBytesInUse", hbm_bytes)
+        ms.register_gauge_func(
+            "tpu.LastAggregationUs", lambda: self._last_aggregation_us
+        )

@@ -1,0 +1,53 @@
+"""Device mesh construction for distributed histogram aggregation.
+
+The reference has no distributed surface at all (SURVEY.md §2 census); this
+module supplies the communication backbone the TPU design adds: a named
+2-axis mesh
+
+    ("stream", "metric")
+
+where the *stream* axis shards the sample firehose (data parallelism — each
+device buckets its own shard of samples, valid because histograms are
+order-free and mergeable) and the *metric* axis shards the dense
+``[num_metrics, num_buckets]`` accumulator rows (tensor parallelism — for
+10k+ metric configs whose dense tensor shouldn't be replicated).  Merges
+ride ``psum`` over the stream axis (ICI within a slice, DCN across
+slices); percentile extraction then runs row-parallel on the metric axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+STREAM_AXIS = "stream"
+METRIC_AXIS = "metric"
+
+
+def make_mesh(
+    stream: Optional[int] = None,
+    metric: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ("stream", "metric") mesh.
+
+    Defaults to all local devices on the stream axis — the right default
+    for the firehose workload, where ingest bandwidth is the bottleneck.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if stream is None:
+        if len(devices) % metric:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by metric={metric}"
+            )
+        stream = len(devices) // metric
+    n = stream * metric
+    if n > len(devices):
+        raise ValueError(
+            f"mesh {stream}x{metric} needs {n} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices[:n]).reshape(stream, metric)
+    return Mesh(grid, (STREAM_AXIS, METRIC_AXIS))

@@ -1,0 +1,58 @@
+"""Metric name <-> dense row id registry.
+
+The reference keys everything by string name in sparse maps
+(metrics.go:112-126).  The device tier instead stores bucket counts in a
+dense ``[num_metrics, num_buckets]`` tensor, so names map to stable integer
+rows.  The registry is append-only (ids are never reused) and thread-safe;
+capacity is fixed so the device accumulator shape is static under jit.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class RegistryFullError(RuntimeError):
+    pass
+
+
+class MetricRegistry:
+    def __init__(self, capacity: int = 16384):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._name_to_id: Dict[str, int] = {}
+        self._names: List[str] = []
+
+    def id_for(self, name: str) -> int:
+        """Return the row id for `name`, registering it on first use."""
+        existing = self._name_to_id.get(name)
+        if existing is not None:
+            return existing
+        with self._lock:
+            existing = self._name_to_id.get(name)
+            if existing is not None:
+                return existing
+            if len(self._names) >= self.capacity:
+                raise RegistryFullError(
+                    f"metric registry is full ({self.capacity} names)"
+                )
+            new_id = len(self._names)
+            self._names.append(name)
+            self._name_to_id[name] = new_id
+            return new_id
+
+    def lookup(self, name: str) -> Optional[int]:
+        return self._name_to_id.get(name)
+
+    def name_for(self, metric_id: int) -> str:
+        return self._names[metric_id]
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._names)
+
+    def __len__(self) -> int:
+        return len(self._names)

@@ -86,18 +86,24 @@ class Submitter:
     def submit(self, request: bytes) -> Optional[Exception]:
         """One best-effort delivery: fresh dial, write, close
         (reference submitter.go:106-116).  Returns the error, if any."""
-        sock_type = (
-            socket.SOCK_STREAM if self.destination_network == "tcp"
-            else socket.SOCK_DGRAM
-        )
         try:
-            sock = socket.socket(socket.AF_INET, sock_type)
-            sock.settimeout(self.dial_timeout)
-            try:
-                sock.connect(self.destination_address)
-                sock.sendall(request)
-            finally:
-                sock.close()
+            if self.destination_network == "tcp":
+                # create_connection resolves both IPv4 and IPv6.
+                with socket.create_connection(
+                    self.destination_address, timeout=self.dial_timeout
+                ) as sock:
+                    sock.sendall(request)
+            else:
+                host, port = self.destination_address
+                family, sock_type, proto, _, addr = socket.getaddrinfo(
+                    host, port, type=socket.SOCK_DGRAM
+                )[0]
+                sock = socket.socket(family, sock_type, proto)
+                sock.settimeout(self.dial_timeout)
+                try:
+                    sock.sendto(request, addr)
+                finally:
+                    sock.close()
             return None
         except OSError as e:
             return e
@@ -105,13 +111,15 @@ class Submitter:
     # -- lifecycle ------------------------------------------------------ #
 
     def _receiver_loop(self) -> None:
+        import queue as _queue
+
         while not self._shutdown.is_set():
             try:
                 metrics = self._metric_chan.get(timeout=0.1)
             except ChannelClosed:
                 return  # evicted by the MetricSystem: no more progress
-            except Exception:
-                continue
+            except _queue.Empty:
+                continue  # poll timeout; re-check shutdown
             try:
                 self._append_to_backlog(self.serializer(metrics))
             except Exception:

@@ -1,0 +1,106 @@
+"""TPUAggregator runtime tests: direct firehose ingestion, the host-tier
+bridge behind the subscription boundary, lifetime aggregates, gauges."""
+
+import time
+
+import numpy as np
+import pytest
+
+from loghisto_tpu import MetricSystem
+from loghisto_tpu.config import MetricConfig
+from loghisto_tpu.parallel.aggregator import TPUAggregator
+
+CFG = MetricConfig(bucket_limit=512)
+
+
+def test_record_and_collect_naming():
+    agg = TPUAggregator(num_metrics=8, config=CFG)
+    rng = np.random.default_rng(0)
+    values = rng.lognormal(4, 1, 10_000).astype(np.float32)
+    ids = np.full(len(values), agg.registry.id_for("latency"), dtype=np.int32)
+    agg.record_batch(ids, values)
+    out = agg.collect().metrics
+    for suffix in ("count", "sum", "avg", "min", "50", "99", "max",
+                   "agg_avg", "agg_count", "agg_sum"):
+        assert f"latency_{suffix}" in out, suffix
+    assert out["latency_count"] == 10_000
+    true_p50 = float(np.quantile(values, 0.5))
+    assert abs(out["latency_50"] / true_p50 - 1) < 0.011
+
+
+def test_collect_resets_interval_but_keeps_lifetime():
+    agg = TPUAggregator(num_metrics=4, config=CFG)
+    agg.record("m", 10.0)
+    first = agg.collect().metrics
+    assert first["m_count"] == 1
+    agg.record("m", 20.0)
+    second = agg.collect().metrics
+    assert second["m_count"] == 1  # interval reset
+    assert second["m_agg_count"] == 2  # lifetime kept
+
+
+def test_collect_without_reset():
+    agg = TPUAggregator(num_metrics=4, config=CFG)
+    agg.record("m", 10.0)
+    agg.collect(reset=False)
+    out = agg.collect(reset=False).metrics
+    assert out["m_count"] == 1
+
+
+def test_empty_metrics_omitted():
+    agg = TPUAggregator(num_metrics=4, config=CFG)
+    agg.registry.id_for("never_recorded")
+    agg.record("real", 5.0)
+    out = agg.collect().metrics
+    assert "real_count" in out
+    assert "never_recorded_count" not in out
+
+
+def test_attach_bridges_host_intervals_to_device():
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    # default bucket_limit (4096): 330000 lands at bucket 1271, which the
+    # test's small 512-bucket config would clip to the edge bucket.
+    agg = TPUAggregator(num_metrics=8, config=MetricConfig())
+    agg.attach(ms)
+    for v in (33.0, 59.0, 330000.0):
+        ms.histogram("histogram1", v)
+    ms.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            out = agg.collect(reset=False).metrics
+            if out.get("histogram1_count") == 3:
+                break
+            time.sleep(0.05)
+        assert out["histogram1_count"] == 3
+        # the golden 331132 decompressed sum survives the device path
+        # (float32 matvec: within float tolerance)
+        assert abs(out["histogram1_sum"] / 331132.0 - 1) < 1e-4
+    finally:
+        agg.detach()
+        ms.stop()
+
+
+def test_device_gauges_registered():
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    agg = TPUAggregator(num_metrics=4, config=CFG)
+    agg.register_device_gauges(ms)
+    gauges = ms.collect_raw_metrics().gauges
+    assert "tpu.HbmBytesInUse" in gauges
+    assert "tpu.LastAggregationUs" in gauges
+
+
+def test_registry_full():
+    from loghisto_tpu.registry import RegistryFullError
+
+    agg = TPUAggregator(num_metrics=2, config=CFG)
+    agg.record("a", 1.0)
+    agg.record("b", 1.0)
+    with pytest.raises(RegistryFullError):
+        agg.record("c", 1.0)
+
+
+def test_record_batch_shape_mismatch():
+    agg = TPUAggregator(num_metrics=2, config=CFG)
+    with pytest.raises(ValueError):
+        agg.record_batch(np.array([0, 1]), np.array([1.0]))
