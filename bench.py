@@ -44,7 +44,7 @@ def main() -> None:
     import jax.numpy as jnp
 
     from loghisto_tpu.config import MetricConfig
-    from loghisto_tpu.ops.ingest import ingest_batch
+    from loghisto_tpu.ops.ingest import make_ingest_fn
     from loghisto_tpu.ops.stats import dense_stats
 
     cfg = MetricConfig(bucket_limit=BUCKET_LIMIT)
@@ -56,9 +56,8 @@ def main() -> None:
     dev = jax.devices()[0]
     platform = dev.platform
 
-    @jax.jit
-    def ingest(acc, ids, values):
-        return ingest_batch(acc, ids, values, cfg.bucket_limit, cfg.precision)
+    # donated accumulator: steady-state ingest is allocation-free
+    ingest = make_ingest_fn(cfg.bucket_limit, cfg.precision)
 
     @jax.jit
     def stats(acc):

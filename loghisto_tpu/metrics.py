@@ -298,6 +298,12 @@ class MetricSystem:
         _subscribers_lock held."""
         evict = []
         for ch in subscribers:
+            if ch.closed:
+                # deliberately closed by its owner (e.g. an orderly detach
+                # before the queued unsubscribe applies): forget it quietly,
+                # no strike logging
+                evict.append(ch)
+                continue
             if ch.offer(item):
                 subscribers[ch] = 0
             else:

@@ -1,0 +1,8 @@
+"""Sketch model families: dense log-bucket histograms (the core model),
+t-digest, and HyperLogLog — all mergeable, all expressed as static-shape
+JAX ops so they jit and shard."""
+
+from loghisto_tpu.models.loghist import LogHistogram
+from loghisto_tpu.models import hll, tdigest
+
+__all__ = ["LogHistogram", "hll", "tdigest"]

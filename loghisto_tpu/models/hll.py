@@ -1,0 +1,95 @@
+"""HyperLogLog cardinality sketch as JAX ops (BASELINE.json configs[3]).
+
+Estimates the number of *distinct* values in a stream — the one statistic
+log-bucket histograms cannot provide.  Batch insertion is a hash +
+segment-max over 2^p registers, so it jits, vectorizes, and (like the
+histogram and t-digest) merges associatively: merge = elementwise register
+max, which rides the same mesh collectives (pmax over the stream axis).
+
+Uses a 32-bit murmur-style finalizer over the float bit pattern (JAX
+default configs lack uint64), giving reliable estimates up to ~1e6
+distinct values at the default p=14 (2^14 registers, ~0.8% relative
+error); beyond that the 32-bit hash space itself starts to saturate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class HLLConfig:
+    p: int = 14  # 2^p registers
+
+    def __post_init__(self):
+        if not 4 <= self.p <= 18:
+            raise ValueError("p must be in [4, 18]")
+
+    @property
+    def num_registers(self) -> int:
+        return 1 << self.p
+
+
+def empty(config: HLLConfig = HLLConfig()) -> jnp.ndarray:
+    return jnp.zeros(config.num_registers, dtype=jnp.int32)
+
+
+def _hash32(x: jnp.ndarray) -> jnp.ndarray:
+    """Murmur3-finalizer-style avalanche over float32 bit patterns."""
+    h = jax.lax.bitcast_convert_type(
+        jnp.asarray(x, dtype=jnp.float32), jnp.uint32
+    )
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def _insert(registers, values, p):
+    m = 1 << p
+    h = _hash32(values)
+    idx = (h & jnp.uint32(m - 1)).astype(jnp.int32)
+    rest = h >> p
+    # rho: position of the first set bit in the remaining (32-p) bits,
+    # counting from 1; all-zero rest gets the maximum 32-p+1.
+    width = 32 - p
+    bits = jnp.arange(width, dtype=jnp.uint32)
+    set_at = (rest[:, None] >> bits[None, :]) & jnp.uint32(1)
+    first = jnp.argmax(set_at, axis=1).astype(jnp.int32)
+    any_set = set_at.any(axis=1)
+    rho = jnp.where(any_set, first + 1, width + 1)
+    maxes = jax.ops.segment_max(rho, idx, num_segments=m)
+    maxes = jnp.maximum(maxes, 0)  # segment_max fills empty with -inf/min
+    return jnp.maximum(registers, maxes)
+
+
+def insert(
+    registers: jnp.ndarray, values, config: HLLConfig = HLLConfig()
+) -> jnp.ndarray:
+    """Add a batch of values to the sketch."""
+    return _insert(registers, jnp.asarray(values, dtype=jnp.float32), config.p)
+
+
+def merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Union of two sketches — elementwise max (use lax.pmax on a mesh)."""
+    return jnp.maximum(a, b)
+
+
+@jax.jit
+def estimate(registers: jnp.ndarray) -> jnp.ndarray:
+    """Distinct-count estimate with linear-counting small-range correction."""
+    m = registers.shape[0]
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    inv = jnp.sum(jnp.exp2(-registers.astype(jnp.float32)))
+    raw = alpha * m * m / inv
+    zeros = jnp.sum(registers == 0)
+    linear = m * jnp.log(m / jnp.maximum(zeros, 1).astype(jnp.float32))
+    use_linear = (raw <= 2.5 * m) & (zeros > 0)
+    return jnp.where(use_linear, linear, raw)

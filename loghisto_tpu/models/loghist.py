@@ -1,0 +1,62 @@
+"""LogHistogram: the dense log-bucket histogram as a standalone, mergeable
+sketch object — one metric's row of the [num_metrics, num_buckets] tensor.
+
+This is the 'model' at the center of the framework: lossless counting into
+log-spaced buckets (the reference's core idea, metrics.go:316-332) carried
+by a dense vector so that insert is a scatter-add, statistics are one CDF
+scan, and merge is elementwise addition (psum across a mesh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from loghisto_tpu.config import MetricConfig
+from loghisto_tpu.ops.ingest import bucket_indices
+from loghisto_tpu.ops.stats import dense_stats
+
+
+@dataclasses.dataclass
+class LogHistogram:
+    """A single-metric dense log-bucket histogram."""
+
+    counts: jnp.ndarray  # int32 [num_buckets]
+    config: MetricConfig = MetricConfig()
+
+    @classmethod
+    def empty(cls, config: MetricConfig = MetricConfig()) -> "LogHistogram":
+        return cls(
+            counts=jnp.zeros(config.num_buckets, dtype=jnp.int32),
+            config=config,
+        )
+
+    def insert(self, values) -> "LogHistogram":
+        values = jnp.asarray(values, dtype=jnp.float32)
+        idx = bucket_indices(values, self.config.bucket_limit,
+                             self.config.precision)
+        return LogHistogram(
+            counts=self.counts.at[idx].add(1), config=self.config
+        )
+
+    def merge(self, other: "LogHistogram") -> "LogHistogram":
+        return LogHistogram(
+            counts=self.counts + other.counts, config=self.config
+        )
+
+    def statistics(self, ps) -> dict:
+        stats = dense_stats(
+            self.counts[None, :], np.asarray(ps, dtype=np.float32),
+            self.config.bucket_limit, self.config.precision,
+        )
+        return {
+            "count": int(stats["counts"][0]),
+            "sum": float(stats["sums"][0]),
+            "percentiles": np.asarray(stats["percentiles"][0]),
+        }
+
+    @property
+    def count(self) -> int:
+        return int(self.counts.sum())

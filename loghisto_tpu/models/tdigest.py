@@ -1,0 +1,130 @@
+"""t-digest sketch as static-shape JAX ops (BASELINE.json configs[3]).
+
+A TPU-shaped reformulation of Dunning's merging t-digest: centroids live in
+fixed-size arrays ``means[C], weights[C]`` (unused slots weight 0), and a
+batch insert is
+
+    concatenate -> sort by mean -> k-scale clustering -> segment-sum
+
+which is fully vectorized and deterministic (no data-dependent loops, so it
+jits and shards).  The k1 scale function ``k(q) = (delta / 2pi) *
+asin(2q - 1)`` bounds cluster count by ~delta while keeping tail clusters
+small — preserving extreme-percentile accuracy, the same design goal as the
+log-bucket histogram codec.
+
+Unlike the log-histogram (lossless counts, bounded relative error), the
+t-digest trades exactness for adaptivity: it needs no a-priori value range.
+Both sketches merge associatively, so the same psum/mesh machinery applies
+(merge = insert the other digest's centroids as weighted samples).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TDigestConfig:
+    capacity: int = 256  # centroid slots (static shape)
+    delta: float = 100.0  # compression: ~delta clusters after a pass
+
+    def __post_init__(self):
+        if self.capacity < 8:
+            raise ValueError("capacity must be >= 8")
+        if self.delta < 8:
+            raise ValueError("delta must be >= 8")
+
+
+def empty(config: TDigestConfig = TDigestConfig()):
+    """(means, weights) of an empty digest."""
+    return (
+        jnp.zeros(config.capacity, dtype=jnp.float32),
+        jnp.zeros(config.capacity, dtype=jnp.float32),
+    )
+
+
+def _k_scale(q: jnp.ndarray, delta: float) -> jnp.ndarray:
+    q = jnp.clip(q, 0.0, 1.0)
+    return (delta / (2.0 * jnp.pi)) * jnp.arcsin(2.0 * q - 1.0)
+
+
+def _compress(means, weights, capacity: int, delta: float):
+    """Cluster sorted centroids by k-scale index and segment-reduce."""
+    total = jnp.maximum(weights.sum(), 1e-30)
+    # midpoint quantile of each centroid
+    cum = jnp.cumsum(weights) - weights / 2.0
+    q = cum / total
+    k = _k_scale(q, delta)
+    cluster = jnp.floor(k - _k_scale(jnp.float32(0.0), delta)).astype(jnp.int32)
+    cluster = jnp.clip(cluster, 0, capacity - 1)
+    # zero-weight slots: park them in the last cluster with zero weight
+    cluster = jnp.where(weights > 0, cluster, capacity - 1)
+    new_w = jax.ops.segment_sum(weights, cluster, num_segments=capacity)
+    new_mw = jax.ops.segment_sum(
+        weights * means, cluster, num_segments=capacity
+    )
+    new_m = jnp.where(new_w > 0, new_mw / jnp.maximum(new_w, 1e-30), 0.0)
+    return new_m, new_w
+
+
+@functools.partial(jax.jit, static_argnames=("capacity", "delta"))
+def _insert(means, weights, values, sample_weights, capacity, delta):
+    all_m = jnp.concatenate([means, values])
+    all_w = jnp.concatenate([weights, sample_weights])
+    # sort by mean, zero-weight entries pushed to the end
+    key = jnp.where(all_w > 0, all_m, jnp.inf)
+    order = jnp.argsort(key)
+    return _compress(all_m[order], all_w[order], capacity, delta)
+
+
+def insert(
+    means, weights, values, sample_weights=None,
+    config: TDigestConfig = TDigestConfig(),
+):
+    """Insert a batch of samples (optionally weighted) into the digest."""
+    values = jnp.asarray(values, dtype=jnp.float32)
+    if sample_weights is None:
+        sample_weights = jnp.ones_like(values)
+    else:
+        sample_weights = jnp.asarray(sample_weights, dtype=jnp.float32)
+    return _insert(
+        means, weights, values, sample_weights,
+        capacity=config.capacity, delta=config.delta,
+    )
+
+
+def merge(a, b, config: TDigestConfig = TDigestConfig()):
+    """Merge two digests — associative, so it rides psum-style tree merges."""
+    return insert(a[0], a[1], b[0], b[1], config=config)
+
+
+@jax.jit
+def quantile(means, weights, qs):
+    """Interpolated quantile estimates from a digest."""
+    w_sorted_idx = jnp.argsort(jnp.where(weights > 0, means, jnp.inf))
+    m = means[w_sorted_idx]
+    w = weights[w_sorted_idx]
+    total = jnp.maximum(w.sum(), 1e-30)
+    cum = jnp.cumsum(w) - w / 2.0
+    qpos = cum / total
+    qs = jnp.asarray(qs, dtype=jnp.float32)
+    # last populated slot; empty tail slots carry qpos == 1.0
+    last = jnp.maximum((w > 0).sum() - 1, 0)
+
+    def one(qq):
+        idx = jnp.searchsorted(qpos, qq)
+        lo = jnp.clip(idx - 1, 0, last)
+        hi = jnp.clip(idx, 0, last)
+        span = jnp.maximum(qpos[hi] - qpos[lo], 1e-30)
+        frac = jnp.clip((qq - qpos[lo]) / span, 0.0, 1.0)
+        return m[lo] + frac * (m[hi] - m[lo])
+
+    return jax.vmap(one)(qs)
+
+
+def count(weights) -> jnp.ndarray:
+    return weights.sum()

@@ -72,14 +72,16 @@ def make_ingest_fn(bucket_limit: int, precision: int = PRECISION):
     return ingest
 
 
-def make_weighted_ingest_fn(bucket_limit: int, precision: int = PRECISION):
-    """Like make_ingest_fn but each sample carries an integer weight —
-    used when merging pre-bucketed host-tier histograms into the device
-    accumulator (weight = bucket count)."""
+def make_weighted_ingest_fn(bucket_limit: int):
+    """Like make_ingest_fn but takes pre-computed *codec* bucket indices
+    plus integer weights — used when merging pre-bucketed host-tier
+    histograms into the device accumulator (weight = bucket count).
+    Bucket indices are clipped to the dense range inside the kernel."""
 
     @functools.partial(jax.jit, donate_argnums=0)
-    def ingest(acc, ids, bucket_idx, weights):
-        return acc.at[sanitize_ids(ids), bucket_idx].add(weights, mode="drop")
+    def ingest(acc, ids, buckets, weights):
+        idx = jnp.clip(buckets, -bucket_limit, bucket_limit) + bucket_limit
+        return acc.at[sanitize_ids(ids), idx].add(weights, mode="drop")
 
     return ingest
 

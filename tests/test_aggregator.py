@@ -100,6 +100,15 @@ def test_registry_full():
         agg.record("c", 1.0)
 
 
+def test_oversized_registry_rejected():
+    from loghisto_tpu.registry import MetricRegistry
+
+    with pytest.raises(ValueError):
+        TPUAggregator(
+            num_metrics=2, config=CFG, registry=MetricRegistry(capacity=10)
+        )
+
+
 def test_record_batch_shape_mismatch():
     agg = TPUAggregator(num_metrics=2, config=CFG)
     with pytest.raises(ValueError):

@@ -72,14 +72,16 @@ def test_jitted_ingest_fn_donation():
 
 
 def test_weighted_ingest():
+    # takes raw codec buckets (may be negative); kernel offsets and clips
     f = make_weighted_ingest_fn(CFG.bucket_limit)
     acc = jnp.zeros((2, CFG.num_buckets), dtype=jnp.int32)
-    acc = f(acc, np.array([0, 0, 1], dtype=np.int32),
-            np.array([10, 10, 20], dtype=np.int32),
-            np.array([5, 3, 7], dtype=np.int32))
+    acc = f(acc, np.array([0, 0, 1, 1], dtype=np.int32),
+            np.array([10, 10, -20, 30000], dtype=np.int32),
+            np.array([5, 3, 7, 2], dtype=np.int32))
     got = np.asarray(acc)
-    assert got[0, 10] == 8
-    assert got[1, 20] == 7
+    assert got[0, CFG.bucket_limit + 10] == 8
+    assert got[1, CFG.bucket_limit - 20] == 7
+    assert got[1, 2 * CFG.bucket_limit] == 2  # clipped to top edge
 
 
 def test_merge_accumulators_is_elementwise_add():

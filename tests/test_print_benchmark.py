@@ -1,0 +1,34 @@
+"""PrintBenchmark harness test — drives the reference's benchmark entry
+point (print_benchmark.go:49-106) for a bounded duration and checks the
+report contents."""
+
+import io
+
+from loghisto_tpu.print_benchmark import print_benchmark
+
+
+def test_print_benchmark_reports_metrics():
+    out = io.StringIO()
+    print_benchmark(
+        "bench_op", concurrency=4, op=lambda: None,
+        duration=0.7, interval=0.2, out=out,
+    )
+    report = out.getvalue()
+    assert "bench_op_count:" in report
+    assert "bench_op_99.9:" in report
+    assert "bench_op_agg_sum:" in report
+    assert "sys.NumGoroutine:" in report
+    # at least one interval reported a nonzero count
+    for line in report.splitlines():
+        if line.startswith("bench_op_count:"):
+            count = float(line.split("\t")[-1])
+            if count > 0:
+                break
+    else:
+        raise AssertionError("no nonzero count line found:\n" + report)
+
+
+def test_print_benchmark_cli_smoke():
+    from loghisto_tpu.print_benchmark import main
+
+    main(["--concurrency", "2", "--seconds", "0.3", "--interval", "0.1"])

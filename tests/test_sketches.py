@@ -1,0 +1,137 @@
+"""Sketch model tests: t-digest quantile accuracy + merge associativity,
+HyperLogLog cardinality accuracy + union merge, LogHistogram model."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from loghisto_tpu.config import MetricConfig
+from loghisto_tpu.models import LogHistogram, hll, tdigest
+
+
+# ---------------------------- t-digest ------------------------------ #
+
+def test_tdigest_quantiles_uniform():
+    cfg = tdigest.TDigestConfig(capacity=256, delta=100)
+    rng = np.random.default_rng(0)
+    data = rng.uniform(0, 1000, 50_000).astype(np.float32)
+    m, w = tdigest.empty(cfg)
+    for chunk in np.split(data, 10):
+        m, w = tdigest.insert(m, w, chunk, config=cfg)
+    qs = np.array([0.01, 0.25, 0.5, 0.75, 0.99], dtype=np.float32)
+    got = np.asarray(tdigest.quantile(m, w, qs))
+    want = np.quantile(data, qs)
+    # mid quantiles within 1.5% of the value range; tails tighter
+    assert np.all(np.abs(got - want) < 15.0)
+    assert abs(float(tdigest.count(w)) - len(data)) < 1e-3 * len(data)
+
+
+def test_tdigest_tail_accuracy_lognormal():
+    cfg = tdigest.TDigestConfig(capacity=512, delta=200)
+    rng = np.random.default_rng(1)
+    data = rng.lognormal(5, 2, 100_000).astype(np.float32)
+    m, w = tdigest.empty(cfg)
+    for chunk in np.split(data, 20):
+        m, w = tdigest.insert(m, w, chunk, config=cfg)
+    got = float(np.asarray(tdigest.quantile(m, w, np.array([0.999]))))
+    want = float(np.quantile(data, 0.999))
+    # Sketch-level accuracy only: lognormal(5,2) spans ~6 orders of
+    # magnitude and repeated re-clustering smears extreme tails.  The
+    # log-bucket histogram is the <=1% tool; the t-digest trades that for
+    # needing no value-range configuration.
+    assert abs(got / want - 1) < 0.25
+
+
+def test_tdigest_merge_matches_combined():
+    cfg = tdigest.TDigestConfig()
+    rng = np.random.default_rng(2)
+    a_data = rng.normal(0, 1, 10_000).astype(np.float32)
+    b_data = rng.normal(10, 1, 10_000).astype(np.float32)
+    am, aw = tdigest.insert(*tdigest.empty(cfg), a_data, config=cfg)
+    bm, bw = tdigest.insert(*tdigest.empty(cfg), b_data, config=cfg)
+    mm, mw = tdigest.merge((am, aw), (bm, bw), config=cfg)
+    combined = np.concatenate([a_data, b_data])
+    got = float(np.asarray(tdigest.quantile(mm, mw, np.array([0.5]))))
+    want = float(np.quantile(combined, 0.5))
+    assert abs(got - want) < 0.5
+    assert abs(float(tdigest.count(mw)) - 20_000) < 1.0
+
+
+def test_tdigest_degenerate_sizes():
+    # single sample: every quantile is that sample
+    m, w = tdigest.insert(*tdigest.empty(), np.array([7.0], dtype=np.float32))
+    got = np.asarray(tdigest.quantile(m, w, np.array([0.0, 0.5, 1.0])))
+    np.testing.assert_allclose(got, 7.0)
+    # two samples: q0 ~ first, q1 ~ second
+    m, w = tdigest.insert(*tdigest.empty(),
+                          np.array([1.0, 3.0], dtype=np.float32))
+    got = np.asarray(tdigest.quantile(m, w, np.array([0.0, 1.0])))
+    assert got[0] <= got[1]
+    assert 1.0 <= got[0] <= 3.0 and 1.0 <= got[1] <= 3.0
+    # empty digest: quantiles are 0 (no samples)
+    got = np.asarray(tdigest.quantile(*tdigest.empty(), np.array([0.5])))
+    assert got[0] == 0.0
+
+
+def test_tdigest_config_validation():
+    with pytest.raises(ValueError):
+        tdigest.TDigestConfig(capacity=2)
+    with pytest.raises(ValueError):
+        tdigest.TDigestConfig(delta=1)
+
+
+# --------------------------- HyperLogLog ---------------------------- #
+
+@pytest.mark.parametrize("true_n", [100, 5_000, 200_000])
+def test_hll_cardinality(true_n):
+    cfg = hll.HLLConfig(p=14)
+    rng = np.random.default_rng(3)
+    values = rng.permutation(true_n).astype(np.float32)
+    # feed duplicates: every value appears ~3x
+    stream = np.tile(values, 3)
+    regs = hll.empty(cfg)
+    for chunk in np.array_split(stream, 5):
+        regs = hll.insert(regs, chunk, config=cfg)
+    est = float(hll.estimate(regs))
+    assert abs(est / true_n - 1) < 0.05, (est, true_n)
+
+
+def test_hll_merge_is_union():
+    cfg = hll.HLLConfig(p=12)
+    a_vals = np.arange(0, 10_000, dtype=np.float32)
+    b_vals = np.arange(5_000, 15_000, dtype=np.float32)
+    a = hll.insert(hll.empty(cfg), a_vals, config=cfg)
+    b = hll.insert(hll.empty(cfg), b_vals, config=cfg)
+    merged = hll.merge(a, b)
+    est = float(hll.estimate(merged))
+    assert abs(est / 15_000 - 1) < 0.06
+    # merge is idempotent and commutative
+    np.testing.assert_array_equal(
+        np.asarray(hll.merge(a, b)), np.asarray(hll.merge(b, a))
+    )
+    np.testing.assert_array_equal(
+        np.asarray(hll.merge(merged, merged)), np.asarray(merged)
+    )
+
+
+def test_hll_config_validation():
+    with pytest.raises(ValueError):
+        hll.HLLConfig(p=2)
+
+
+# --------------------------- LogHistogram --------------------------- #
+
+def test_loghistogram_model():
+    cfg = MetricConfig(bucket_limit=1024)
+    h = LogHistogram.empty(cfg)
+    rng = np.random.default_rng(4)
+    data = rng.lognormal(3, 1, 10_000)
+    h = h.insert(data.astype(np.float32))
+    assert h.count == 10_000
+    stats = h.statistics([0.5, 0.99])
+    assert abs(stats["percentiles"][0] / np.quantile(data, 0.5) - 1) < 0.011
+    assert abs(stats["percentiles"][1] / np.quantile(data, 0.99) - 1) < 0.011
+
+    h2 = LogHistogram.empty(cfg).insert(np.array([7.0], dtype=np.float32))
+    merged = h.merge(h2)
+    assert merged.count == 10_001
