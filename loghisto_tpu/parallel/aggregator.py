@@ -146,7 +146,12 @@ class TPUAggregator:
     ):
         self.config = config
         self.num_metrics = num_metrics
-        self.registry = registry or MetricRegistry(capacity=num_metrics)
+        # explicit None check: an empty registry is falsy (it has __len__),
+        # so `registry or ...` would silently discard a caller's registry
+        self.registry = (
+            registry if registry is not None
+            else MetricRegistry(capacity=num_metrics)
+        )
         if self.registry.capacity > num_metrics:
             raise ValueError(
                 f"registry capacity {self.registry.capacity} exceeds "
