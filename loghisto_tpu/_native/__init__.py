@@ -1,0 +1,242 @@
+"""ctypes loader and wrapper for the native ingest runtime.
+
+Builds `ingest.cpp` with g++ on first use (cached next to the source);
+every entry point degrades gracefully: `available()` is False when no
+compiler exists, and callers fall back to the pure-NumPy host tier.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "ingest.cpp")
+_LIB_PATH = os.path.join(_HERE, "libloghisto_ingest.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_error: str | None = None
+
+
+def _build() -> str | None:
+    import tempfile
+
+    # Compile to a private temp path, then atomically rename: concurrent
+    # builders (e.g. pytest-xdist workers) can never dlopen a half-written
+    # .so.
+    fd, tmp = tempfile.mkstemp(dir=_HERE, suffix=".so.tmp")
+    os.close(fd)
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+        "-o", tmp, _SRC,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+        if proc.returncode != 0:
+            return f"g++ failed: {proc.stderr[-2000:]}"
+        os.replace(tmp, _LIB_PATH)
+        return None
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"g++ invocation failed: {e}"
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def _load():
+    global _lib, _build_error
+    with _lib_lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            stale = not os.path.exists(_LIB_PATH) or (
+                os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+            )
+        except OSError:
+            # e.g. prebuilt .so shipped without the source: use it as-is
+            stale = not os.path.exists(_LIB_PATH)
+        if stale:
+            _build_error = _build()
+            if _build_error is not None:
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            _build_error = f"dlopen failed: {e}"
+            return None
+
+        lib.lh_create.restype = ctypes.c_void_p
+        lib.lh_create.argtypes = [ctypes.c_int, ctypes.c_int64]
+        lib.lh_destroy.argtypes = [ctypes.c_void_p]
+        lib.lh_num_shards.restype = ctypes.c_int
+        lib.lh_num_shards.argtypes = [ctypes.c_void_p]
+        lib.lh_record.restype = ctypes.c_int64
+        lib.lh_record.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int32, ctypes.c_double,
+        ]
+        lib.lh_record_batch.restype = ctypes.c_int64
+        lib.lh_record_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+        ]
+        lib.lh_drain.restype = ctypes.c_int64
+        lib.lh_drain.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+        ]
+        lib.lh_dropped.restype = ctypes.c_uint64
+        lib.lh_dropped.argtypes = [ctypes.c_void_p]
+        lib.lh_compress.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int16),
+        ]
+        lib.lh_decompress.argtypes = [
+            ctypes.POINTER(ctypes.c_int16), ctypes.c_int64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.lh_accumulate_dense.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int32,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> str | None:
+    _load()
+    return _build_error
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _f64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def _i16(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int16))
+
+
+def compress(values: np.ndarray, precision: int = 100) -> np.ndarray:
+    """Native vectorized codec (matches ops.codec.compress_np exactly)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_build_error}")
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    out = np.empty(len(values), dtype=np.int16)
+    lib.lh_compress(_f64(values), len(values), precision, _i16(out))
+    return out
+
+
+def accumulate_dense(
+    ids: np.ndarray, values: np.ndarray, num_metrics: int,
+    bucket_limit: int, precision: int = 100,
+    acc: np.ndarray | None = None,
+) -> np.ndarray:
+    """Native dense accumulate — CPU verification twin of the device kernel."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_build_error}")
+    ids = np.ascontiguousarray(ids, dtype=np.int32)
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    if acc is None:
+        acc = np.zeros((num_metrics, 2 * bucket_limit + 1), dtype=np.uint32)
+    lib.lh_accumulate_dense(
+        _i32(ids), _f64(values), len(ids), precision, bucket_limit,
+        acc.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), num_metrics,
+    )
+    return acc
+
+
+class NativeIngestBuffer:
+    """Lock-striped native staging buffer for (metric_id, value) samples.
+
+    Writers call record/record_batch (GIL released inside the C call);
+    the reaper drains shards for vectorized compression + device upload.
+    Full shards shed samples and count them (`dropped`), mirroring the
+    reference's shed-don't-block policy."""
+
+    def __init__(self, num_shards: int = 16, capacity_per_shard: int = 1 << 20):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native library unavailable: {_build_error}")
+        self._lib = lib
+        self._handle = lib.lh_create(num_shards, capacity_per_shard)
+        if not self._handle:
+            raise MemoryError("lh_create failed")
+        self.num_shards = num_shards
+        self.capacity_per_shard = capacity_per_shard
+        self._shard_counter = 0
+        self._tl = threading.local()
+
+    def _shard(self) -> int:
+        idx = getattr(self._tl, "idx", None)
+        if idx is None:
+            idx = self._shard_counter % self.num_shards
+            self._shard_counter += 1
+            self._tl.idx = idx
+        return idx
+
+    def record(self, metric_id: int, value: float) -> int:
+        return self._lib.lh_record(
+            self._handle, self._shard(), metric_id, value
+        )
+
+    def record_batch(self, ids: np.ndarray, values: np.ndarray) -> int:
+        ids = np.ascontiguousarray(ids, dtype=np.int32)
+        values = np.ascontiguousarray(values, dtype=np.float64)
+        if ids.shape != values.shape:
+            raise ValueError("ids and values must have the same shape")
+        return int(self._lib.lh_record_batch(
+            self._handle, self._shard(), _i32(ids), _f64(values), len(ids)
+        ))
+
+    def drain(self) -> tuple[np.ndarray, np.ndarray]:
+        """Swap out and return all staged samples from every shard."""
+        cap = self.capacity_per_shard
+        all_ids, all_values = [], []
+        ids = np.empty(cap, dtype=np.int32)
+        values = np.empty(cap, dtype=np.float64)
+        for shard in range(self.num_shards):
+            n = self._lib.lh_drain(
+                self._handle, shard, _i32(ids), _f64(values), cap
+            )
+            if n > 0:
+                all_ids.append(ids[:n].copy())
+                all_values.append(values[:n].copy())
+        if not all_ids:
+            return (np.empty(0, dtype=np.int32), np.empty(0, dtype=np.float64))
+        return np.concatenate(all_ids), np.concatenate(all_values)
+
+    @property
+    def dropped(self) -> int:
+        return int(self._lib.lh_dropped(self._handle))
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.lh_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

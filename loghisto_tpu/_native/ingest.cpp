@@ -1,0 +1,160 @@
+// Native ingest runtime: lock-striped sample staging + vectorized codec.
+//
+// This is the C++ analog of the reference's hot path machinery (the Go
+// library's RWMutex + atomic lock-promotion ingest, metrics.go:251-295),
+// rebuilt for the batch/device design: writers append (metric_id, value)
+// pairs into per-shard ring buffers under a per-shard mutex with the GIL
+// released, and the reaper drains whole shards for vectorized compression
+// and device upload.  Also provides the log-bucket codec and a dense
+// accumulate as portable C for host-side verification and CPU fallback.
+//
+// Plain C ABI on purpose: loaded via ctypes (no pybind11 in the image).
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <new>
+#include <vector>
+
+namespace {
+
+constexpr int16_t kBucketLimit = 32767;
+
+struct Shard {
+  std::mutex mu;
+  std::vector<int32_t> ids;
+  std::vector<double> values;
+  // lifetime counters of dropped samples (buffer full)
+  std::atomic<uint64_t> dropped{0};
+};
+
+struct Buffer {
+  std::vector<Shard> shards;
+  int64_t capacity_per_shard;
+  explicit Buffer(int num_shards, int64_t cap)
+      : shards(num_shards), capacity_per_shard(cap) {
+    for (auto& s : shards) {
+      s.ids.reserve(static_cast<size_t>(std::min<int64_t>(cap, 1 << 20)));
+      s.values.reserve(static_cast<size_t>(std::min<int64_t>(cap, 1 << 20)));
+    }
+  }
+};
+
+inline int16_t compress_one(double value, int precision) {
+  double mag = std::floor(precision * std::log1p(std::fabs(value)) + 0.5);
+  if (std::isnan(mag)) mag = 0.0;  // NaN -> bucket 0 (matches device tier)
+  if (mag > kBucketLimit) mag = kBucketLimit;
+  int16_t i = static_cast<int16_t>(mag);
+  return value < 0 ? static_cast<int16_t>(-i) : i;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* lh_create(int num_shards, int64_t capacity_per_shard) {
+  if (num_shards < 1 || capacity_per_shard < 1) return nullptr;
+  return new (std::nothrow) Buffer(num_shards, capacity_per_shard);
+}
+
+void lh_destroy(void* handle) { delete static_cast<Buffer*>(handle); }
+
+int lh_num_shards(void* handle) {
+  return static_cast<int>(static_cast<Buffer*>(handle)->shards.size());
+}
+
+// Append a batch into one shard. Returns the number of samples accepted
+// (the rest were dropped: shed-don't-block, like the reference's
+// slow-subscriber policy).
+int64_t lh_record_batch(void* handle, int shard_idx, const int32_t* ids,
+                        const double* values, int64_t n) {
+  Buffer* buf = static_cast<Buffer*>(handle);
+  Shard& shard = buf->shards[shard_idx % buf->shards.size()];
+  std::lock_guard<std::mutex> lock(shard.mu);
+  int64_t room = buf->capacity_per_shard -
+                 static_cast<int64_t>(shard.ids.size());
+  int64_t take = std::max<int64_t>(0, std::min(room, n));
+  if (take > 0) {
+    shard.ids.insert(shard.ids.end(), ids, ids + take);
+    shard.values.insert(shard.values.end(), values, values + take);
+  }
+  if (take < n) shard.dropped.fetch_add(static_cast<uint64_t>(n - take));
+  return take;
+}
+
+int64_t lh_record(void* handle, int shard_idx, int32_t id, double value) {
+  return lh_record_batch(handle, shard_idx, &id, &value, 1);
+}
+
+// Swap one shard's buffers and copy them out. Returns the sample count
+// (<= max_n; anything beyond max_n is discarded and counted as dropped).
+int64_t lh_drain(void* handle, int shard_idx, int32_t* ids_out,
+                 double* values_out, int64_t max_n) {
+  Buffer* buf = static_cast<Buffer*>(handle);
+  Shard& shard = buf->shards[shard_idx % buf->shards.size()];
+  std::vector<int32_t> ids;
+  std::vector<double> values;
+  {
+    std::lock_guard<std::mutex> lock(shard.mu);
+    ids.swap(shard.ids);
+    values.swap(shard.values);
+    // keep the warm reserve: without this, every post-drain interval
+    // re-grows through the realloc ladder while holding the shard mutex
+    size_t warm = std::min<size_t>(
+        ids.capacity(), static_cast<size_t>(buf->capacity_per_shard));
+    shard.ids.reserve(warm);
+    shard.values.reserve(warm);
+  }
+  int64_t n = static_cast<int64_t>(ids.size());
+  int64_t take = std::min(n, max_n);
+  if (take > 0) {
+    std::memcpy(ids_out, ids.data(), take * sizeof(int32_t));
+    std::memcpy(values_out, values.data(), take * sizeof(double));
+  }
+  if (take < n) shard.dropped.fetch_add(static_cast<uint64_t>(n - take));
+  return take;
+}
+
+uint64_t lh_dropped(void* handle) {
+  Buffer* buf = static_cast<Buffer*>(handle);
+  uint64_t total = 0;
+  for (auto& s : buf->shards) total += s.dropped.load();
+  return total;
+}
+
+// Vectorized codec: values -> int16 buckets (reference metrics.go:316-322
+// semantics, saturating instead of wrapping).
+void lh_compress(const double* values, int64_t n, int precision,
+                 int16_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = compress_one(values[i], precision);
+}
+
+void lh_decompress(const int16_t* buckets, int64_t n, int precision,
+                   double* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    double f = std::exp(std::fabs(static_cast<double>(buckets[i])) /
+                        precision) - 1.0;
+    out[i] = buckets[i] < 0 ? -f : f;
+  }
+}
+
+// Dense accumulate on host: the CPU fallback / verification twin of the
+// device scatter-add kernel. acc is uint32[num_metrics][2*bucket_limit+1].
+void lh_accumulate_dense(const int32_t* ids, const double* values, int64_t n,
+                         int precision, int bucket_limit, uint32_t* acc,
+                         int32_t num_metrics) {
+  const int64_t row = 2 * static_cast<int64_t>(bucket_limit) + 1;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t id = ids[i];
+    if (id < 0 || id >= num_metrics) continue;
+    int32_t b = compress_one(values[i], precision);
+    if (b < -bucket_limit) b = -bucket_limit;
+    if (b > bucket_limit) b = bucket_limit;
+    ++acc[id * row + b + bucket_limit];
+  }
+}
+
+}  // extern "C"

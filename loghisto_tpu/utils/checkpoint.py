@@ -1,0 +1,162 @@
+"""Checkpoint / resume for metric state (SURVEY.md §5.4).
+
+The reference has no persistence: its lifetime stores die with the process
+(metrics.go:111-126).  Long-running TPU aggregation wants better — the
+dense bucket tensor plus lifetime scalars fully determine the statistics,
+and both serialize trivially.
+
+Format: a single .npz with JSON-encoded name tables, written atomically
+(temp file + rename) so a crash mid-write can't corrupt the last good
+snapshot.  Covers the host MetricSystem (lifetime counter store +
+histogram aggregate store) and the TPUAggregator (dense accumulator,
+registry names, lifetime aggregates).  Interval caches are deliberately
+NOT persisted: in-flight samples of a crashed interval follow the
+shed-don't-block philosophy of the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+from loghisto_tpu.metrics import MetricSystem
+
+FORMAT_VERSION = 1
+
+
+def save(
+    path: str,
+    metric_system: Optional[MetricSystem] = None,
+    aggregator=None,
+) -> None:
+    """Atomically snapshot lifetime state to `path` (.npz)."""
+    payload = {"version": np.int64(FORMAT_VERSION)}
+
+    if metric_system is not None:
+        with metric_system._store_lock:
+            counters = dict(metric_system._counter_store)
+            agg = {
+                name: (entry[0], entry[1])
+                for name, entry in metric_system._histogram_agg_store.items()
+            }
+        payload["ms_counter_names"] = _names_arr(counters.keys())
+        payload["ms_counter_values"] = np.array(
+            list(counters.values()), dtype=np.uint64
+        )
+        payload["ms_agg_names"] = _names_arr(agg.keys())
+        payload["ms_agg_sums"] = np.array(
+            [v[0] for v in agg.values()], dtype=np.float64
+        )
+        payload["ms_agg_counts"] = np.array(
+            [v[1] for v in agg.values()], dtype=np.uint64
+        )
+
+    if aggregator is not None:
+        aggregator.flush()
+        with aggregator._lock:
+            acc = np.asarray(aggregator._acc)
+        with aggregator._agg_lock:
+            agg_items = sorted(aggregator._agg.items())
+        payload["agg_acc"] = acc
+        payload["agg_names"] = _names_arr(aggregator.registry.names())
+        payload["agg_ids"] = np.array([k for k, _ in agg_items], dtype=np.int64)
+        payload["agg_sums"] = np.array(
+            [v[0] for _, v in agg_items], dtype=np.float64
+        )
+        payload["agg_counts"] = np.array(
+            [v[1] for _, v in agg_items], dtype=np.uint64
+        )
+
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())  # data durable before the rename
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def restore(
+    path: str,
+    metric_system: Optional[MetricSystem] = None,
+    aggregator=None,
+) -> None:
+    """Restore lifetime state saved by save().  Loads into the provided
+    objects (merging over their current lifetime state)."""
+    with np.load(path, allow_pickle=False) as data:
+        version = int(data["version"])
+        if version != FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint version {version}")
+
+        if metric_system is not None and "ms_counter_names" in data:
+            names = _arr_names(data["ms_counter_names"])
+            values = data["ms_counter_values"]
+            agg_names = _arr_names(data["ms_agg_names"])
+            sums = data["ms_agg_sums"]
+            counts = data["ms_agg_counts"]
+            with metric_system._store_lock:
+                for name, value in zip(names, values):
+                    metric_system._counter_store[name] = int(value)
+                for name, s, c in zip(agg_names, sums, counts):
+                    metric_system._histogram_agg_store[name] = [
+                        float(s), int(c)
+                    ]
+
+        if aggregator is not None and "agg_acc" in data:
+            import jax.numpy as jnp
+
+            acc = data["agg_acc"]
+            if acc.shape != (
+                aggregator.num_metrics, aggregator.config.num_buckets
+            ):
+                raise ValueError(
+                    f"checkpoint accumulator shape {acc.shape} does not "
+                    "match the aggregator's configuration"
+                )
+            # Remap by NAME, not by row id: the target registry may already
+            # hold other names at the checkpoint's ids.  Saved rows are
+            # added into the target's rows for their re-registered ids.
+            saved_names = _arr_names(data["agg_names"])
+            row_map = [
+                (saved_id, aggregator.registry.id_for(name))
+                for saved_id, name in enumerate(saved_names)
+            ]
+            remapped = np.zeros(
+                (aggregator.num_metrics, acc.shape[1]), dtype=acc.dtype
+            )
+            for saved_id, new_id in row_map:
+                remapped[new_id] += acc[saved_id]
+            with aggregator._lock:
+                aggregator._acc = aggregator._acc + jnp.asarray(remapped)
+            id_remap = dict(row_map)
+            with aggregator._agg_lock:
+                for mid, s, c in zip(
+                    data["agg_ids"], data["agg_sums"], data["agg_counts"]
+                ):
+                    new_id = id_remap.get(int(mid))
+                    if new_id is None:
+                        continue
+                    entry = aggregator._agg.setdefault(new_id, [0, 0])
+                    entry[0] += float(s)
+                    entry[1] += int(c)
+
+
+def _names_arr(names) -> np.ndarray:
+    return np.frombuffer(
+        json.dumps(list(names)).encode(), dtype=np.uint8
+    ).copy()
+
+
+def _arr_names(arr: np.ndarray) -> list[str]:
+    return json.loads(arr.tobytes().decode())

@@ -1,0 +1,90 @@
+"""Checkpoint/resume round-trip tests."""
+
+import numpy as np
+import pytest
+
+from loghisto_tpu import MetricSystem
+from loghisto_tpu.config import MetricConfig
+from loghisto_tpu.parallel.aggregator import TPUAggregator
+from loghisto_tpu.utils import checkpoint
+
+CFG = MetricConfig(bucket_limit=256)
+
+
+def test_metric_system_roundtrip(tmp_path):
+    ms = MetricSystem(interval=1e-6, sys_stats=False)
+    ms.counter("reqs", 500)
+    ms.histogram("lat", 100.0)
+    ms.process_metrics(ms.collect_raw_metrics())  # folds lifetime state
+
+    path = str(tmp_path / "snap.npz")
+    checkpoint.save(path, metric_system=ms)
+
+    fresh = MetricSystem(interval=1e-6, sys_stats=False)
+    checkpoint.restore(path, metric_system=fresh)
+    metrics = fresh.process_metrics(fresh.collect_raw_metrics()).metrics
+    assert metrics["reqs"] == 500  # lifetime counter survived
+    raw = fresh.collect_raw_metrics()
+    fresh.histogram("lat", 100.0)
+    raw = fresh.collect_raw_metrics()
+    processed = fresh.process_metrics(raw)
+    fresh._attach_aggregates(processed, raw)
+    # lifetime agg includes the pre-restart sample
+    assert processed.metrics["lat_agg_count"] == 2
+
+
+def test_aggregator_roundtrip(tmp_path):
+    agg = TPUAggregator(num_metrics=8, config=CFG)
+    agg.record("m", 50.0)
+    agg.record("m", 70.0)
+    agg.collect()  # lifetime folded; interval reset
+    agg.record("m", 90.0)
+    agg.flush()
+
+    path = str(tmp_path / "agg.npz")
+    checkpoint.save(path, aggregator=agg)
+
+    fresh = TPUAggregator(num_metrics=8, config=CFG)
+    checkpoint.restore(path, aggregator=fresh)
+    out = fresh.collect().metrics
+    assert out["m_count"] == 1  # the unreaped interval sample survived
+    assert out["m_agg_count"] == 3  # 2 lifetime + 1 restored interval
+
+
+def test_restore_into_nonempty_registry_remaps_by_name(tmp_path):
+    # The target already has a different name at the checkpoint's row 0:
+    # restore must remap by name, not overwrite rows by id.
+    # values within CFG's bucket range (limit 256 covers |v| <= ~11.9)
+    agg = TPUAggregator(num_metrics=8, config=CFG)
+    agg.record("m", 5.0)
+    agg.flush()
+    path = str(tmp_path / "agg.npz")
+    checkpoint.save(path, aggregator=agg)
+
+    target = TPUAggregator(num_metrics=8, config=CFG)
+    target.record("x", 9.0)  # takes id 0 in the target registry
+    target.flush()
+    checkpoint.restore(path, aggregator=target)
+    out = target.collect().metrics
+    assert out["x_count"] == 1 and abs(out["x_avg"] / 9.0 - 1) < 0.01
+    assert out["m_count"] == 1 and abs(out["m_avg"] / 5.0 - 1) < 0.01
+
+
+def test_restore_rejects_shape_mismatch(tmp_path):
+    agg = TPUAggregator(num_metrics=8, config=CFG)
+    agg.record("m", 1.0)
+    path = str(tmp_path / "agg.npz")
+    checkpoint.save(path, aggregator=agg)
+    other = TPUAggregator(num_metrics=4, config=CFG)
+    with pytest.raises(ValueError):
+        checkpoint.restore(path, aggregator=other)
+
+
+def test_atomic_write_leaves_no_tmp(tmp_path):
+    ms = MetricSystem(interval=1e-6, sys_stats=False)
+    ms.counter("c", 1)
+    path = str(tmp_path / "snap.npz")
+    checkpoint.save(path, metric_system=ms)
+    checkpoint.save(path, metric_system=ms)  # overwrite is atomic
+    leftovers = [p for p in tmp_path.iterdir() if p.suffix == ".tmp"]
+    assert not leftovers

@@ -1,0 +1,96 @@
+"""Fast-path ingest parity: one-hot MXU matmul histogram and the fused
+Pallas row kernel must agree exactly with the scatter path."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from loghisto_tpu.config import MetricConfig
+from loghisto_tpu.ops.ingest import ingest_batch
+from loghisto_tpu.ops.matmul_hist import ingest_batch_matmul
+from loghisto_tpu.ops.pallas_kernels import (
+    SAMPLE_TILE,
+    make_pallas_row_ingest,
+    pallas_histogram_row,
+)
+
+CFG = MetricConfig(bucket_limit=512)
+
+
+def _scatter_reference(ids, values, m):
+    acc = jnp.zeros((m, CFG.num_buckets), dtype=jnp.int32)
+    return np.asarray(ingest_batch(acc, ids, values, CFG.bucket_limit))
+
+
+def test_matmul_hist_matches_scatter():
+    rng = np.random.default_rng(0)
+    m, n = 4, 8192
+    ids = rng.integers(0, m, n).astype(np.int32)
+    values = rng.lognormal(2, 1.5, n).astype(np.float32)
+    values[::7] *= -1  # negatives too
+    acc = jnp.zeros((m, CFG.num_buckets), dtype=jnp.int32)
+    got = np.asarray(
+        ingest_batch_matmul(acc, ids, values, CFG.bucket_limit)
+    )
+    np.testing.assert_array_equal(got, _scatter_reference(ids, values, m))
+
+
+def test_matmul_hist_drops_bad_ids():
+    ids = np.array([0, -1, 99], dtype=np.int32)
+    values = np.ones(3, dtype=np.float32)
+    acc = jnp.zeros((2, CFG.num_buckets), dtype=jnp.int32)
+    got = np.asarray(ingest_batch_matmul(acc, ids, values, CFG.bucket_limit))
+    assert got.sum() == 1
+
+
+def test_matmul_hist_accumulates():
+    ids = np.zeros(16, dtype=np.int32)
+    values = np.full(16, 5.0, dtype=np.float32)
+    acc = jnp.zeros((1, CFG.num_buckets), dtype=jnp.int32)
+    acc = ingest_batch_matmul(acc, ids, values, CFG.bucket_limit)
+    acc = ingest_batch_matmul(acc, ids, values, CFG.bucket_limit)
+    assert int(np.asarray(acc).sum()) == 32
+
+
+def test_pallas_row_matches_scatter():
+    rng = np.random.default_rng(1)
+    n = 2 * SAMPLE_TILE
+    values = rng.lognormal(2, 1.5, n).astype(np.float32)
+    values[::5] *= -1
+    row = jnp.zeros(CFG.num_buckets, dtype=jnp.int32)
+    got = np.asarray(
+        pallas_histogram_row(row, values, CFG.bucket_limit, interpret=True)
+    )
+    want = _scatter_reference(
+        np.zeros(n, dtype=np.int32), values, 1
+    )[0]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_row_accumulates_existing_counts():
+    values = np.full(SAMPLE_TILE, 7.0, dtype=np.float32)
+    row = jnp.zeros(CFG.num_buckets, dtype=jnp.int32)
+    f = make_pallas_row_ingest(CFG.num_buckets, CFG.bucket_limit,
+                               interpret=True)
+    row = f(row, values)
+    row = f(row, values)
+    got = np.asarray(row)
+    assert got.sum() == 2 * SAMPLE_TILE
+
+
+def test_pallas_row_rejects_ragged_batch():
+    row = jnp.zeros(CFG.num_buckets, dtype=jnp.int32)
+    with pytest.raises(ValueError):
+        pallas_histogram_row(
+            row, np.ones(100, dtype=np.float32), CFG.bucket_limit,
+            interpret=True,
+        )
+
+
+def test_pallas_row_nan_goes_to_zero_bucket():
+    values = np.full(SAMPLE_TILE, np.nan, dtype=np.float32)
+    row = jnp.zeros(CFG.num_buckets, dtype=jnp.int32)
+    got = np.asarray(
+        pallas_histogram_row(row, values, CFG.bucket_limit, interpret=True)
+    )
+    assert got[CFG.bucket_limit] == SAMPLE_TILE  # center bucket

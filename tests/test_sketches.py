@@ -33,7 +33,7 @@ def test_tdigest_tail_accuracy_lognormal():
     m, w = tdigest.empty(cfg)
     for chunk in np.split(data, 20):
         m, w = tdigest.insert(m, w, chunk, config=cfg)
-    got = float(np.asarray(tdigest.quantile(m, w, np.array([0.999]))))
+    got = float(np.asarray(tdigest.quantile(m, w, np.array([0.999])))[0])
     want = float(np.quantile(data, 0.999))
     # Sketch-level accuracy only: lognormal(5,2) spans ~6 orders of
     # magnitude and repeated re-clustering smears extreme tails.  The
@@ -51,7 +51,7 @@ def test_tdigest_merge_matches_combined():
     bm, bw = tdigest.insert(*tdigest.empty(cfg), b_data, config=cfg)
     mm, mw = tdigest.merge((am, aw), (bm, bw), config=cfg)
     combined = np.concatenate([a_data, b_data])
-    got = float(np.asarray(tdigest.quantile(mm, mw, np.array([0.5]))))
+    got = float(np.asarray(tdigest.quantile(mm, mw, np.array([0.5])))[0])
     want = float(np.quantile(combined, 0.5))
     assert abs(got - want) < 0.5
     assert abs(float(tdigest.count(mw)) - 20_000) < 1.0
