@@ -15,12 +15,18 @@ from loghisto_tpu.metrics import (
 
 __version__ = "0.1.0"
 
+# Package-level default system, mirroring the reference's
+# `var Metrics = NewMetricSystem(60*time.Second, true)` (metrics.go:137-139).
+# Not auto-started; call Metrics.start() to begin collection.
+Metrics = MetricSystem(interval=60.0, sys_stats=True)
+
 __all__ = [
     "Channel",
     "ChannelClosed",
     "DEFAULT_PERCENTILES",
     "MetricConfig",
     "MetricSystem",
+    "Metrics",
     "ProcessedMetricSet",
     "RawMetricSet",
     "TimerToken",

@@ -1,0 +1,189 @@
+"""Synthetic latency firehose: on-device sample generation -> dense
+aggregation -> per-interval export replay (BASELINE.json configs[4]:
+"1B-sample/sec synthetic latency firehose -> OpenTSDB submitter replay").
+
+Host->device transfer cannot carry 1B samples/s, so the firehose
+generates samples *on device* inside the jitted step (Zipf-skewed metric
+ids via inverse-CDF searchsorted, lognormal latencies), fuses generation
+with compress+scatter-add, and only the per-interval statistics leave the
+device.  Each interval's ProcessedMetricSet is serialized with the
+OpenTSDB protocol and either written to a sink address or summarized to
+stdout.
+
+CLI: python -m loghisto_tpu.firehose --metrics 10000 --seconds 5
+     [--sink host:port] [--batch 4194304]
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import functools
+import sys
+import time
+from typing import Optional
+
+import numpy as np
+
+from loghisto_tpu.config import DEFAULT_PERCENTILES, MetricConfig
+from loghisto_tpu.metrics import ProcessedMetricSet
+from loghisto_tpu.opentsdb import opentsdb_protocol
+
+
+def zipf_cdf(num_metrics: int, s: float = 1.3) -> np.ndarray:
+    weights = 1.0 / np.arange(1, num_metrics + 1, dtype=np.float64) ** s
+    cdf = np.cumsum(weights)
+    return (cdf / cdf[-1]).astype(np.float32)
+
+
+def make_firehose_step(
+    num_metrics: int,
+    batch: int,
+    config: MetricConfig,
+    mean: float = 10.0,
+    sigma: float = 2.0,
+):
+    """Jitted (acc, key) -> (acc', key'): generate one batch on device and
+    accumulate it.  Generation fuses into the ingest program, so HBM
+    traffic is accumulator-only."""
+    import jax
+    import jax.numpy as jnp
+
+    from loghisto_tpu.ops.ingest import ingest_batch
+
+    cdf = zipf_cdf(num_metrics)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(acc, key):
+        key, k1, k2 = jax.random.split(key, 3)
+        u = jax.random.uniform(k1, (batch,), dtype=jnp.float32)
+        ids = jnp.searchsorted(jnp.asarray(cdf), u).astype(jnp.int32)
+        values = jnp.exp(
+            mean + sigma * jax.random.normal(k2, (batch,), dtype=jnp.float32)
+        )
+        acc = ingest_batch(
+            acc, ids, values, config.bucket_limit, config.precision
+        )
+        return acc, key
+
+    return step
+
+
+def run_firehose(
+    num_metrics: int = 10_000,
+    batch: int = 1 << 22,
+    seconds: float = 5.0,
+    interval: float = 1.0,
+    sink: Optional[tuple[str, int]] = None,
+    config: Optional[MetricConfig] = None,
+    out=sys.stdout,
+) -> dict:
+    """Run the firehose; returns a summary dict (samples/s, intervals)."""
+    import jax
+    import jax.numpy as jnp
+
+    from loghisto_tpu.ops.stats import dense_stats
+
+    config = config or MetricConfig()
+    step = make_firehose_step(num_metrics, batch, config)
+    stats_fn = jax.jit(
+        functools.partial(
+            dense_stats,
+            bucket_limit=config.bucket_limit,
+            precision=config.precision,
+        )
+    )
+    labels, ps = zip(*(
+        (label, p) for label, p in DEFAULT_PERCENTILES.items()
+        if 0.0 <= p <= 1.0
+    ))
+    ps = np.asarray(ps, dtype=np.float32)
+
+    acc = jnp.zeros((num_metrics, config.num_buckets), dtype=jnp.int32)
+    key = jax.random.key(0)
+    acc, key = step(acc, key)  # compile
+    jax.block_until_ready(acc)
+    acc = jnp.zeros_like(acc)  # discard warm-up samples from interval 1
+
+    total_samples = 0
+    intervals = 0
+    t_start = time.perf_counter()
+    while time.perf_counter() - t_start < seconds:
+        t_int = time.perf_counter()
+        interval_samples = 0
+        while time.perf_counter() - t_int < interval:
+            acc, key = step(acc, key)
+            interval_samples += batch
+        stats = stats_fn(acc, ps)
+        counts = np.asarray(stats["counts"])
+        pcts = np.asarray(stats["percentiles"])
+        sums = np.asarray(stats["sums"])
+        acc = jnp.zeros_like(acc)
+        intervals += 1
+        total_samples += interval_samples
+
+        # serialize the hottest metrics for the export replay
+        metrics = {}
+        hot = np.argsort(counts)[::-1][:16]
+        for mid in hot:
+            if counts[mid] == 0:
+                continue
+            name = f"firehose_{mid}"
+            metrics[f"{name}_count"] = float(counts[mid])
+            metrics[f"{name}_sum"] = float(sums[mid])
+            for label, value in zip(labels, pcts[mid]):
+                metrics[label % name] = float(value)
+        pms = ProcessedMetricSet(
+            time=_dt.datetime.now(tz=_dt.timezone.utc), metrics=metrics
+        )
+        payload = opentsdb_protocol(pms)
+        if sink is not None:
+            from loghisto_tpu.submitter import send_once
+
+            err = send_once("tcp", sink, payload)
+            status = "sent" if err is None else f"error: {err}"
+        else:
+            status = f"{len(payload)} bytes serialized"
+        rate = interval_samples / (time.perf_counter() - t_int)
+        out.write(
+            f"interval {intervals}: {interval_samples:,} samples "
+            f"({rate/1e6:.1f}M/s), export {status}\n"
+        )
+        out.flush()
+
+    elapsed = time.perf_counter() - t_start
+    summary = {
+        "samples_per_s": total_samples / elapsed,
+        "total_samples": total_samples,
+        "intervals": intervals,
+        "platform": jax.devices()[0].platform,
+    }
+    out.write(
+        f"firehose: {summary['samples_per_s']/1e6:.1f}M samples/s over "
+        f"{intervals} intervals on {summary['platform']}\n"
+    )
+    return summary
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--metrics", type=int, default=10_000)
+    parser.add_argument("--batch", type=int, default=1 << 22)
+    parser.add_argument("--seconds", type=float, default=5.0)
+    parser.add_argument("--interval", type=float, default=1.0)
+    parser.add_argument("--sink", default=None,
+                        help="host:port OpenTSDB sink (optional)")
+    args = parser.parse_args(argv)
+    sink = None
+    if args.sink:
+        host, port = args.sink.rsplit(":", 1)
+        sink = (host, int(port))
+    run_firehose(
+        num_metrics=args.metrics, batch=args.batch, seconds=args.seconds,
+        interval=args.interval, sink=sink,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -143,7 +143,21 @@ class TPUAggregator:
         percentiles: Mapping[str, float] = DEFAULT_PERCENTILES,
         registry: Optional[MetricRegistry] = None,
         batch_size: int = 1 << 16,
+        mesh: Optional[Mesh] = None,
+        native_staging: bool = False,
     ):
+        """When `mesh` is given (a ("stream","metric") mesh from
+        parallel.mesh.make_mesh), the dense accumulator is laid out
+        metric-row-sharded across the mesh and every jitted step runs
+        SPMD — XLA partitions the scatter-adds and the CDF scan row-wise
+        and inserts the collectives.  num_metrics must divide evenly by
+        the metric-axis size.
+
+        `native_staging=True` stages record_batch samples in the C++
+        lock-striped buffer (loghisto_tpu._native) instead of Python
+        lists — writers release the GIL in the C call, and overflow sheds
+        with an exposed drop counter.  Requires the native library; falls
+        back (with a log line) when unavailable."""
         self.config = config
         self.num_metrics = num_metrics
         # explicit None check: an empty registry is falsy (it has __len__),
@@ -166,9 +180,38 @@ class TPUAggregator:
         self._pending_values: list[np.ndarray] = []
         self._pending_count = 0
 
-        self._acc = jnp.zeros(
-            (num_metrics, config.num_buckets), dtype=jnp.int32
-        )
+        self._native_buf = None
+        self._native_staged = 0
+        if native_staging:
+            from loghisto_tpu import _native
+
+            if _native.available():
+                self._native_buf = _native.NativeIngestBuffer(
+                    num_shards=16, capacity_per_shard=max(batch_size * 4, 1 << 20)
+                )
+            else:
+                import logging
+
+                logging.getLogger("loghisto_tpu").warning(
+                    "native staging requested but unavailable (%s); using "
+                    "Python staging", _native.build_error(),
+                )
+
+        self.mesh = mesh
+        if mesh is not None:
+            n_metric = mesh.shape[METRIC_AXIS]
+            if num_metrics % n_metric:
+                raise ValueError(
+                    f"num_metrics={num_metrics} not divisible by the mesh "
+                    f"metric axis ({n_metric})"
+                )
+            self._acc = make_sharded_accumulator(
+                mesh, num_metrics, config.num_buckets
+            )
+        else:
+            self._acc = jnp.zeros(
+                (num_metrics, config.num_buckets), dtype=jnp.int32
+            )
         self._ingest = make_ingest_fn(config.bucket_limit, config.precision)
         self._weighted_ingest = make_weighted_ingest_fn(config.bucket_limit)
         self._stats_fn = jax.jit(
@@ -200,6 +243,14 @@ class TPUAggregator:
         values = np.asarray(values, dtype=np.float32)
         if ids.shape != values.shape:
             raise ValueError("ids and values must have the same shape")
+        if self._native_buf is not None:
+            self._native_buf.record_batch(ids, values.astype(np.float64))
+            # keep the documented auto-flush contract in the native path
+            # (counter is racy-but-monotonic; worst case an extra flush)
+            self._native_staged += len(ids)
+            if self._native_staged >= self.batch_size:
+                self.flush()
+            return
         with self._lock:
             self._pending_ids.append(ids)
             self._pending_values.append(values)
@@ -214,6 +265,14 @@ class TPUAggregator:
         Batches are shipped in fixed-size chunks (padding the tail with
         id -1, which the kernel drops) so the jitted ingest compiles for
         exactly one shape instead of one executable per batch length."""
+        if self._native_buf is not None:
+            self._native_staged = 0
+            nids, nvalues = self._native_buf.drain()
+            if len(nids):
+                with self._lock:
+                    self._pending_ids.append(nids)
+                    self._pending_values.append(nvalues.astype(np.float32))
+                    self._pending_count += len(nids)
         with self._lock:
             if not self._pending_count:
                 return
@@ -317,6 +376,7 @@ class TPUAggregator:
         with self._lock:
             acc = self._acc
             if reset:
+                # zeros_like preserves the NamedSharding in mesh mode
                 self._acc = jnp.zeros_like(acc)
             else:
                 acc = acc + 0  # defensive copy; donation-safe snapshot
@@ -382,3 +442,8 @@ class TPUAggregator:
         ms.register_gauge_func(
             "tpu.LastAggregationUs", lambda: self._last_aggregation_us
         )
+        if self._native_buf is not None:
+            ms.register_gauge_func(
+                "tpu.StagingDropped",
+                lambda: float(self._native_buf.dropped),
+            )

@@ -33,6 +33,35 @@ BACKLOG_SLOTS = 60
 DIAL_TIMEOUT_S = 5.0
 
 
+def send_once(
+    network: str,
+    address: tuple[str, int],
+    payload: bytes,
+    timeout: float = DIAL_TIMEOUT_S,
+) -> Optional[Exception]:
+    """One best-effort delivery: fresh dial, write, close.  Returns the
+    error, if any (never raises for network failures)."""
+    try:
+        if network == "tcp":
+            # create_connection resolves both IPv4 and IPv6.
+            with socket.create_connection(address, timeout=timeout) as sock:
+                sock.sendall(payload)
+        else:
+            host, port = address
+            family, sock_type, proto, _, addr = socket.getaddrinfo(
+                host, port, type=socket.SOCK_DGRAM
+            )[0]
+            sock = socket.socket(family, sock_type, proto)
+            sock.settimeout(timeout)
+            try:
+                sock.sendto(payload, addr)
+            finally:
+                sock.close()
+        return None
+    except OSError as e:
+        return e
+
+
 class Submitter:
     """Receives processed metric sets, serializes them, and attempts
     delivery to `destination_address` with retry from an evicting backlog."""
@@ -86,27 +115,10 @@ class Submitter:
     def submit(self, request: bytes) -> Optional[Exception]:
         """One best-effort delivery: fresh dial, write, close
         (reference submitter.go:106-116).  Returns the error, if any."""
-        try:
-            if self.destination_network == "tcp":
-                # create_connection resolves both IPv4 and IPv6.
-                with socket.create_connection(
-                    self.destination_address, timeout=self.dial_timeout
-                ) as sock:
-                    sock.sendall(request)
-            else:
-                host, port = self.destination_address
-                family, sock_type, proto, _, addr = socket.getaddrinfo(
-                    host, port, type=socket.SOCK_DGRAM
-                )[0]
-                sock = socket.socket(family, sock_type, proto)
-                sock.settimeout(self.dial_timeout)
-                try:
-                    sock.sendto(request, addr)
-                finally:
-                    sock.close()
-            return None
-        except OSError as e:
-            return e
+        return send_once(
+            self.destination_network, self.destination_address, request,
+            self.dial_timeout,
+        )
 
     # -- lifecycle ------------------------------------------------------ #
 

@@ -100,6 +100,39 @@ def test_registry_full():
         agg.record("c", 1.0)
 
 
+def test_mesh_mode_matches_single_device():
+    import jax
+
+    from loghisto_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(stream=4, metric=2, devices=jax.devices()[:8])
+    rng = np.random.default_rng(9)
+    ids = rng.integers(0, 8, 50_000).astype(np.int32)
+    values = rng.lognormal(1, 0.8, 50_000).astype(np.float32)
+
+    single = TPUAggregator(num_metrics=8, config=CFG)
+    sharded = TPUAggregator(num_metrics=8, config=CFG, mesh=mesh)
+    for agg in (single, sharded):
+        for i in range(8):
+            agg.registry.id_for(f"m{i}")
+        agg.record_batch(ids, values)
+    a = single.collect().metrics
+    b = sharded.collect().metrics
+    assert a.keys() == b.keys()
+    for key in a:
+        assert abs(a[key] - b[key]) <= max(1e-4 * abs(a[key]), 1e-4), key
+
+
+def test_mesh_mode_requires_divisible_metrics():
+    import jax
+
+    from loghisto_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(stream=2, metric=4, devices=jax.devices()[:8])
+    with pytest.raises(ValueError):
+        TPUAggregator(num_metrics=10, config=CFG, mesh=mesh)
+
+
 def test_oversized_registry_rejected():
     from loghisto_tpu.registry import MetricRegistry
 
