@@ -39,7 +39,35 @@ def zipf_ids(rng: np.random.Generator, n: int, m: int) -> np.ndarray:
     return ((raw - 1) % m).astype(np.int32)
 
 
+def _start_watchdog(timeout_s: float = 420.0):
+    """Fail loudly if device init wedges (the axon tunnel can hang
+    indefinitely): after timeout_s without the ready flag, dump stacks to
+    stderr and exit nonzero so the driver records a failure instead of
+    hanging."""
+    import threading
+
+    ready = threading.Event()
+
+    def watch():
+        if not ready.wait(timeout=timeout_s):
+            import faulthandler
+            import sys
+
+            print(
+                f"bench: device init/compile exceeded {timeout_s}s; aborting",
+                file=sys.stderr,
+            )
+            faulthandler.dump_traceback(file=sys.stderr)
+            import os
+
+            os._exit(3)
+
+    threading.Thread(target=watch, daemon=True).start()
+    return ready
+
+
 def main() -> None:
+    ready = _start_watchdog()
     import jax
     import jax.numpy as jnp
 
@@ -74,6 +102,7 @@ def main() -> None:
     acc = ingest(acc, ids, values)
     s = stats(acc)
     jax.block_until_ready((acc, s))
+    ready.set()  # device is alive and compiled; disarm the watchdog
 
     # timed ingest steps with periodic stats extraction
     t0 = time.perf_counter()

@@ -186,8 +186,11 @@ class TPUAggregator:
             from loghisto_tpu import _native
 
             if _native.available():
+                # 16 shards x 4*batch_size x 12B ~= 48 MB at the default
+                # batch_size; scale with the workload, don't floor at 1M
                 self._native_buf = _native.NativeIngestBuffer(
-                    num_shards=16, capacity_per_shard=max(batch_size * 4, 1 << 20)
+                    num_shards=16,
+                    capacity_per_shard=max(batch_size * 4, 1 << 16),
                 )
             else:
                 import logging
@@ -244,11 +247,16 @@ class TPUAggregator:
         if ids.shape != values.shape:
             raise ValueError("ids and values must have the same shape")
         if self._native_buf is not None:
-            self._native_buf.record_batch(ids, values.astype(np.float64))
-            # keep the documented auto-flush contract in the native path
-            # (counter is racy-but-monotonic; worst case an extra flush)
-            self._native_staged += len(ids)
-            if self._native_staged >= self.batch_size:
+            accepted = self._native_buf.record_batch(
+                ids, values.astype(np.float64)
+            )
+            # keep the documented auto-flush contract in the native path;
+            # counted under the lock (an unsynchronized += can lose
+            # updates and *miss* flushes) and only for accepted samples
+            with self._lock:
+                self._native_staged += accepted
+                should_flush = self._native_staged >= self.batch_size
+            if should_flush:
                 self.flush()
             return
         with self._lock:
@@ -266,7 +274,8 @@ class TPUAggregator:
         id -1, which the kernel drops) so the jitted ingest compiles for
         exactly one shape instead of one executable per batch length."""
         if self._native_buf is not None:
-            self._native_staged = 0
+            with self._lock:
+                self._native_staged = 0
             nids, nvalues = self._native_buf.drain()
             if len(nids):
                 with self._lock:
@@ -380,7 +389,10 @@ class TPUAggregator:
                 self._acc = jnp.zeros_like(acc)
             else:
                 acc = acc + 0  # defensive copy; donation-safe snapshot
-        stats = self._stats_fn(acc, np.asarray(ps, dtype=np.float32))
+        from loghisto_tpu.utils.trace import maybe_capture
+
+        with maybe_capture("loghisto_collect"):
+            stats = self._stats_fn(acc, np.asarray(ps, dtype=np.float32))
         counts = np.asarray(stats["counts"])
         sums = np.asarray(stats["sums"])
         pcts = np.asarray(stats["percentiles"])

@@ -1,0 +1,53 @@
+"""Tracing/profiling hooks (SURVEY.md §5.1).
+
+The reference *is* its own profiling tool (timers -> latency histograms);
+the rebuild keeps that surface and adds optional capture of device traces
+around aggregation steps:
+
+  * ``profile_region("ingest")`` — a context manager that wraps a block in
+    a ``jax.profiler.TraceAnnotation`` so it shows up named in TensorBoard
+    / Perfetto traces.
+  * ``capture(path)`` — records a full ``jax.profiler`` trace of the
+    enclosed block to `path`.
+  * Setting ``LOGHISTO_TRACE_DIR`` makes TPUAggregator.collect() capture
+    its device program automatically (zero code changes for users).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def profile_region(name: str) -> Iterator[None]:
+    import jax.profiler
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def capture(path: str) -> Iterator[None]:
+    """Record a jax.profiler trace of the enclosed block to `path`."""
+    import jax.profiler
+
+    jax.profiler.start_trace(path)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def maybe_capture(region: str) -> Iterator[None]:
+    """Capture a trace when LOGHISTO_TRACE_DIR is set; otherwise just
+    annotate the region."""
+    trace_dir = os.environ.get("LOGHISTO_TRACE_DIR")
+    if trace_dir:
+        with capture(os.path.join(trace_dir, region)):
+            yield
+    else:
+        with profile_region(region):
+            yield
