@@ -68,6 +68,65 @@ def make_firehose_step(
     return step
 
 
+def make_mesh_firehose_step(
+    mesh,
+    num_metrics: int,
+    batch: int,
+    config: MetricConfig,
+    mean: float = 10.0,
+    sigma: float = 2.0,
+):
+    """Distributed firehose step over a ("stream","metric") mesh: each
+    device generates its own sample shard (keys split per stream index),
+    builds a local dense histogram, psum-merges across the stream axis,
+    and folds into the metric-sharded accumulator — the BASELINE
+    configs[2] '8-way psum merge' exercised end to end."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from loghisto_tpu.parallel.aggregator import local_histogram_fold
+    from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
+
+    n_stream = mesh.shape[STREAM_AXIS]
+    n_metric = mesh.shape[METRIC_AXIS]
+    if num_metrics % n_metric or batch % n_stream:
+        raise ValueError("metrics/batch must divide the mesh axes")
+    rows = num_metrics // n_metric
+    local_batch = batch // n_stream
+    cdf = zipf_cdf(num_metrics)
+
+    def local(acc_local, key):
+        si = jax.lax.axis_index(STREAM_AXIS)
+        k = jax.random.fold_in(key[0], si)
+        k1, k2 = jax.random.split(k)
+        u = jax.random.uniform(k1, (local_batch,), dtype=jnp.float32)
+        ids = jnp.searchsorted(jnp.asarray(cdf), u).astype(jnp.int32)
+        values = jnp.exp(
+            mean + sigma * jax.random.normal(k2, (local_batch,),
+                                             dtype=jnp.float32)
+        )
+        return local_histogram_fold(
+            acc_local, ids, values, rows,
+            config.bucket_limit, config.precision,
+        )
+
+    step = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(METRIC_AXIS, None), P()),
+        out_specs=P(METRIC_AXIS, None),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def wrapped(acc, key):
+        # proper split: the carry key must never collide with the
+        # per-device fold_in keys consumed inside the step
+        key, sub = jax.random.split(key)
+        return step(acc, sub[None]), key
+
+    return wrapped
+
+
 def run_firehose(
     num_metrics: int = 10_000,
     batch: int = 1 << 22,
@@ -75,16 +134,21 @@ def run_firehose(
     interval: float = 1.0,
     sink: Optional[tuple[str, int]] = None,
     config: Optional[MetricConfig] = None,
+    mesh=None,
     out=sys.stdout,
 ) -> dict:
-    """Run the firehose; returns a summary dict (samples/s, intervals)."""
+    """Run the firehose; returns a summary dict (samples/s, intervals).
+    With `mesh`, generation+aggregation run SPMD with psum merges."""
     import jax
     import jax.numpy as jnp
 
     from loghisto_tpu.ops.stats import dense_stats
 
     config = config or MetricConfig()
-    step = make_firehose_step(num_metrics, batch, config)
+    if mesh is not None:
+        step = make_mesh_firehose_step(mesh, num_metrics, batch, config)
+    else:
+        step = make_firehose_step(num_metrics, batch, config)
     stats_fn = jax.jit(
         functools.partial(
             dense_stats,
@@ -98,7 +162,12 @@ def run_firehose(
     ))
     ps = np.asarray(ps, dtype=np.float32)
 
-    acc = jnp.zeros((num_metrics, config.num_buckets), dtype=jnp.int32)
+    if mesh is not None:
+        from loghisto_tpu.parallel.aggregator import make_sharded_accumulator
+
+        acc = make_sharded_accumulator(mesh, num_metrics, config.num_buckets)
+    else:
+        acc = jnp.zeros((num_metrics, config.num_buckets), dtype=jnp.int32)
     key = jax.random.key(0)
     acc, key = step(acc, key)  # compile
     jax.block_until_ready(acc)
@@ -174,14 +243,23 @@ def main(argv=None) -> None:
     parser.add_argument("--interval", type=float, default=1.0)
     parser.add_argument("--sink", default=None,
                         help="host:port OpenTSDB sink (optional)")
+    parser.add_argument("--mesh", action="store_true",
+                        help="run SPMD over all devices (psum merges)")
+    parser.add_argument("--mesh-metric", type=int, default=1,
+                        help="metric-axis size of the mesh")
     args = parser.parse_args(argv)
     sink = None
     if args.sink:
         host, port = args.sink.rsplit(":", 1)
         sink = (host, int(port))
+    mesh = None
+    if args.mesh:
+        from loghisto_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(metric=args.mesh_metric)
     run_firehose(
         num_metrics=args.metrics, batch=args.batch, seconds=args.seconds,
-        interval=args.interval, sink=sink,
+        interval=args.interval, sink=sink, mesh=mesh,
     )
 
 

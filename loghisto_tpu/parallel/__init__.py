@@ -1,0 +1,18 @@
+"""Distributed aggregation: device meshes, shard_map steps, the
+TPUAggregator runtime, and multi-host initialization."""
+
+from loghisto_tpu.parallel.aggregator import (
+    TPUAggregator,
+    make_distributed_step,
+    make_sharded_accumulator,
+)
+from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS, make_mesh
+
+__all__ = [
+    "METRIC_AXIS",
+    "STREAM_AXIS",
+    "TPUAggregator",
+    "make_distributed_step",
+    "make_mesh",
+    "make_sharded_accumulator",
+]

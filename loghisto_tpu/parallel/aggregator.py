@@ -47,6 +47,28 @@ from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
 from loghisto_tpu.registry import MetricRegistry
 
 
+def local_histogram_fold(
+    acc_local: jnp.ndarray,
+    ids: jnp.ndarray,
+    values: jnp.ndarray,
+    rows_per_shard: int,
+    bucket_limit: int,
+    precision: int = PRECISION,
+) -> jnp.ndarray:
+    """The sharded-ingest core, shared by every shard_map step: offset ids
+    into this metric shard's row range (ids below it go negative, so
+    sanitize before drop-mode scatter or they'd wrap to the last row),
+    bucket the local sample shard, psum the dense histograms across the
+    stream axis, and fold into the accumulator.  Must run inside
+    shard_map on a ("stream", "metric") mesh."""
+    shard = jax.lax.axis_index(METRIC_AXIS)
+    local_ids = sanitize_ids(ids - shard * rows_per_shard)
+    bidx = bucket_indices(values, bucket_limit, precision)
+    hist = jnp.zeros_like(acc_local).at[local_ids, bidx].add(1, mode="drop")
+    hist = jax.lax.psum(hist, STREAM_AXIS)
+    return acc_local + hist
+
+
 def make_distributed_step(
     mesh: Mesh,
     num_metrics: int,
@@ -79,16 +101,9 @@ def make_distributed_step(
     ps = jnp.asarray(percentile_values, dtype=jnp.float32)
 
     def local_step(acc_local, ids, values):
-        shard = jax.lax.axis_index(METRIC_AXIS)
-        # ids below this shard's range go negative; sanitize so drop-mode
-        # really drops them instead of wrapping to the last row.
-        local_ids = sanitize_ids(ids - shard * rows_per_shard)
-        bidx = bucket_indices(values, bucket_limit, precision)
-        hist = jnp.zeros_like(acc_local).at[local_ids, bidx].add(
-            1, mode="drop"
+        acc_local = local_histogram_fold(
+            acc_local, ids, values, rows_per_shard, bucket_limit, precision
         )
-        hist = jax.lax.psum(hist, STREAM_AXIS)
-        acc_local = acc_local + hist
         stats = dense_stats(acc_local, ps, bucket_limit, precision)
         return acc_local, stats
 

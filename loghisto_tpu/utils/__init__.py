@@ -1,0 +1,1 @@
+"""Auxiliary subsystems: process/TPU gauges, checkpointing, tracing."""

@@ -1,0 +1,46 @@
+"""Multi-host helper tests (single-process semantics on the 8-device CPU
+mesh; the same APIs span hosts once jax.distributed is initialized)."""
+
+import jax
+import numpy as np
+import pytest
+
+from loghisto_tpu.parallel import make_distributed_step, make_mesh
+from loghisto_tpu.parallel.multihost import (
+    global_mesh,
+    local_sample_shard,
+    make_global_arrays,
+)
+from loghisto_tpu.config import MetricConfig
+
+
+def test_local_sample_shard_covers_batch():
+    start, size = local_sample_shard(800)
+    # single process: local == global
+    assert (start, size) == (0, 800)
+    with pytest.raises(ValueError):
+        local_sample_shard(801)  # not divisible by 8 devices
+
+
+def test_global_mesh_spans_devices():
+    mesh = global_mesh(metric=2)
+    assert mesh.shape["metric"] == 2
+    assert mesh.shape["stream"] * 2 == jax.device_count()
+
+
+def test_make_global_arrays_feed_distributed_step():
+    cfg = MetricConfig(bucket_limit=256)
+    mesh = make_mesh(stream=8, metric=1)
+    m, n = 8, 4096
+    rng = np.random.default_rng(0)
+    ids_local = rng.integers(0, m, n).astype(np.int32)
+    values_local = rng.lognormal(2, 1, n).astype(np.float32)
+    gids, gvalues = make_global_arrays(mesh, ids_local, values_local)
+    step = make_distributed_step(
+        mesh, m, cfg.bucket_limit, np.array([0.5, 1.0], dtype=np.float32)
+    )
+    from loghisto_tpu.parallel import make_sharded_accumulator
+
+    acc = make_sharded_accumulator(mesh, m, cfg.num_buckets)
+    acc, stats = step(acc, gids, gvalues)
+    assert int(np.asarray(stats["counts"]).sum()) == n
