@@ -35,6 +35,29 @@ def zipf_cdf(num_metrics: int, s: float = 1.3) -> np.ndarray:
     return (cdf / cdf[-1]).astype(np.float32)
 
 
+def _make_sample_generator(
+    num_metrics: int, mean: float, sigma: float
+):
+    """Shared synthetic workload: Zipf-skewed metric ids (inverse-CDF
+    searchsorted) + lognormal latencies.  Used by both the single-device
+    and the mesh firehose steps so the distributions can never diverge."""
+    import jax
+    import jax.numpy as jnp
+
+    cdf = zipf_cdf(num_metrics)
+
+    def generate(key, n: int):
+        k1, k2 = jax.random.split(key)
+        u = jax.random.uniform(k1, (n,), dtype=jnp.float32)
+        ids = jnp.searchsorted(jnp.asarray(cdf), u).astype(jnp.int32)
+        values = jnp.exp(
+            mean + sigma * jax.random.normal(k2, (n,), dtype=jnp.float32)
+        )
+        return ids, values
+
+    return generate
+
+
 def make_firehose_step(
     num_metrics: int,
     batch: int,
@@ -46,20 +69,15 @@ def make_firehose_step(
     accumulate it.  Generation fuses into the ingest program, so HBM
     traffic is accumulator-only."""
     import jax
-    import jax.numpy as jnp
 
     from loghisto_tpu.ops.ingest import ingest_batch
 
-    cdf = zipf_cdf(num_metrics)
+    generate = _make_sample_generator(num_metrics, mean, sigma)
 
     @functools.partial(jax.jit, donate_argnums=0)
     def step(acc, key):
-        key, k1, k2 = jax.random.split(key, 3)
-        u = jax.random.uniform(k1, (batch,), dtype=jnp.float32)
-        ids = jnp.searchsorted(jnp.asarray(cdf), u).astype(jnp.int32)
-        values = jnp.exp(
-            mean + sigma * jax.random.normal(k2, (batch,), dtype=jnp.float32)
-        )
+        key, sub = jax.random.split(key)
+        ids, values = generate(sub, batch)
         acc = ingest_batch(
             acc, ids, values, config.bucket_limit, config.precision
         )
@@ -94,18 +112,11 @@ def make_mesh_firehose_step(
         raise ValueError("metrics/batch must divide the mesh axes")
     rows = num_metrics // n_metric
     local_batch = batch // n_stream
-    cdf = zipf_cdf(num_metrics)
+    generate = _make_sample_generator(num_metrics, mean, sigma)
 
     def local(acc_local, key):
         si = jax.lax.axis_index(STREAM_AXIS)
-        k = jax.random.fold_in(key[0], si)
-        k1, k2 = jax.random.split(k)
-        u = jax.random.uniform(k1, (local_batch,), dtype=jnp.float32)
-        ids = jnp.searchsorted(jnp.asarray(cdf), u).astype(jnp.int32)
-        values = jnp.exp(
-            mean + sigma * jax.random.normal(k2, (local_batch,),
-                                             dtype=jnp.float32)
-        )
+        ids, values = generate(jax.random.fold_in(key[0], si), local_batch)
         return local_histogram_fold(
             acc_local, ids, values, rows,
             config.bucket_limit, config.precision,

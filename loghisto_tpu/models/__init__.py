@@ -3,6 +3,6 @@ t-digest, and HyperLogLog — all mergeable, all expressed as static-shape
 JAX ops so they jit and shard."""
 
 from loghisto_tpu.models.loghist import LogHistogram
-from loghisto_tpu.models import hll, tdigest
+from loghisto_tpu.models import hll, moments, tdigest
 
-__all__ = ["LogHistogram", "hll", "tdigest"]
+__all__ = ["LogHistogram", "hll", "moments", "tdigest"]

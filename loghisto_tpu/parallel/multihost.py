@@ -15,10 +15,6 @@ This module provides the thin host-side pieces:
   * `local_sample_shard(...)` — helper for carving each host's sample
     stream out of a global batch axis (each host feeds only its local
     devices; no host ever materializes the global batch).
-  * `host_merge_raw(...)` — an all-hosts histogram union over the JAX
-    client (multihost_utils.process_allgather of the sparse interval
-    maps is unnecessary — dense rows add; we go through the device mesh).
-
 There is no bespoke RPC layer on purpose: the reference's TCP submitter is
 one-way *export*, not coordination, and remains exactly that here; all
 peer-to-peer communication is XLA collectives.

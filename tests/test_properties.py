@@ -1,0 +1,94 @@
+"""Property-based tests (hypothesis): the numeric contracts hold for ALL
+inputs, not just the golden values — codec round-trip accuracy, percentile
+ordering/monotonicity, merge associativity."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from loghisto_tpu.config import INT16_BUCKET_LIMIT
+from loghisto_tpu.ops.codec import (
+    compress_np,
+    compress_scalar,
+    decompress_np,
+    decompress_scalar,
+)
+from loghisto_tpu.ops.stats import percentiles_sparse
+
+finite_values = st.floats(
+    min_value=-1e100, max_value=1e100,
+    allow_nan=False, allow_infinity=False,
+)
+
+
+@given(finite_values)
+@settings(max_examples=300, deadline=None)
+def test_codec_roundtrip_contract(v):
+    rt = decompress_scalar(compress_scalar(v))
+    if abs(v) >= 0.51:
+        assert abs(rt / v - 1) <= 0.01
+    else:
+        # documented low-precision zone: absolute error stays tiny
+        assert abs(rt - v) <= 0.01
+
+
+@given(finite_values)
+@settings(max_examples=200, deadline=None)
+def test_codec_sign_and_monotonicity_local(v):
+    b = compress_scalar(v)
+    assert (b > 0) == (v >= 0.005 and b != 0) or b == 0 or (v < 0) == (b < 0)
+    # monotone: a strictly larger magnitude never gets a smaller bucket
+    if 0 <= v < 1e99:
+        assert compress_scalar(v * 1.5 + 0.1) >= b
+
+
+@given(st.lists(finite_values, min_size=1, max_size=200))
+@settings(max_examples=100, deadline=None)
+def test_scalar_vector_codec_agree(values):
+    arr = np.array(values, dtype=np.float64)
+    got = compress_np(arr)
+    want = np.array([compress_scalar(float(v)) for v in arr], dtype=np.int16)
+    np.testing.assert_array_equal(got, want)
+
+
+@given(
+    st.dictionaries(
+        st.integers(-INT16_BUCKET_LIMIT, INT16_BUCKET_LIMIT),
+        st.integers(1, 10_000),
+        min_size=1, max_size=50,
+    ),
+    st.lists(st.floats(0.0, 1.0), min_size=1, max_size=10),
+)
+@settings(max_examples=100, deadline=None)
+def test_percentiles_are_monotone_and_within_range(bucket_counts, ps):
+    buckets = np.fromiter(bucket_counts.keys(), dtype=np.int64)
+    counts = np.fromiter(bucket_counts.values(), dtype=np.uint64)
+    ps_sorted = np.sort(np.array(ps))
+    out = percentiles_sparse(buckets, counts, ps_sorted)
+    # monotone in p
+    assert (np.diff(out) >= -1e-12).all()
+    # every output is an existing bucket representative (exact: both sides
+    # come from the same decompress on the same integers)
+    reps = set(decompress_np(buckets).tolist())
+    for v in out:
+        assert float(v) in reps
+    # p=0 -> min representative, p=1 -> max representative
+    if ps_sorted[0] == 0.0:
+        assert out[0] == decompress_np(buckets).min()
+    if ps_sorted[-1] == 1.0:
+        assert out[-1] == decompress_np(buckets).max()
+
+
+@given(
+    st.lists(st.floats(-1e6, 1e6, allow_nan=False), min_size=1, max_size=100),
+    st.lists(st.floats(-1e6, 1e6, allow_nan=False), min_size=1, max_size=100),
+)
+@settings(max_examples=50, deadline=None)
+def test_histogram_merge_is_order_free(a, b):
+    """Bucketing a+b together equals bucketing separately and summing the
+    sparse maps — the property every psum merge in the framework rides."""
+    from collections import Counter
+
+    ca = Counter(compress_np(np.array(a)).tolist())
+    cb = Counter(compress_np(np.array(b)).tolist())
+    cab = Counter(compress_np(np.array(a + b)).tolist())
+    assert ca + cb == cab

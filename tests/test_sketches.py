@@ -119,6 +119,110 @@ def test_hll_config_validation():
         hll.HLLConfig(p=2)
 
 
+# ---------------------------- moments -------------------------------- #
+
+def test_moments_gaussian_quantiles():
+    from loghisto_tpu.models import moments
+
+    rng = np.random.default_rng(5)
+    data = rng.normal(100.0, 15.0, 50_000).astype(np.float32)
+    st = moments.empty()
+    for chunk in np.split(data, 5):
+        st = moments.insert(st, chunk)
+    mean, std, skew, kurt = (
+        float(x) for x in moments.standardized_moments(st)
+    )
+    assert abs(mean - 100.0) < 0.5
+    assert abs(std - 15.0) < 0.5
+    assert abs(skew) < 0.1
+    assert abs(kurt - 3.0) < 0.1
+    got = np.asarray(moments.quantile(st, np.array([0.5, 0.9, 0.99])))
+    want = np.quantile(data, [0.5, 0.9, 0.99])
+    assert np.abs(got - want).max() < 1.0  # Gaussian: CF is near-exact
+    assert float(moments.count(st)) == 50_000
+
+
+def test_moments_merge_matches_combined():
+    from loghisto_tpu.models import moments
+
+    rng = np.random.default_rng(6)
+    a = rng.normal(0, 1, 10_000).astype(np.float32)
+    b = rng.normal(5, 2, 10_000).astype(np.float32)
+    sa = moments.insert(moments.empty(), a)
+    sb = moments.insert(moments.empty(), b)
+    merged = moments.merge(sa, sb)
+    combined = moments.insert(moments.empty(), np.concatenate([a, b]))
+    for field in ("count", "scale", "min", "max"):
+        assert float(getattr(merged, field)) == float(
+            getattr(combined, field)
+        )
+    got = [float(x) for x in moments.standardized_moments(merged)]
+    want = [float(x) for x in moments.standardized_moments(combined)]
+    np.testing.assert_allclose(got, want, rtol=2e-3)
+
+
+def test_moments_degenerate_cases():
+    from loghisto_tpu.models import moments
+
+    # empty -> 0 (like the other sketches)
+    assert float(np.asarray(
+        moments.quantile(moments.empty(), np.array([0.5])))[0]) == 0.0
+    # single sample -> that sample at every quantile, no NaN
+    one = moments.insert(moments.empty(), np.array([42.0], dtype=np.float32))
+    got = np.asarray(moments.quantile(one, np.array([0.0, 0.5, 1.0])))
+    np.testing.assert_allclose(got, 42.0)
+    # q=0/q=1 are the exact observed range even under strong skew
+    neg = moments.insert(
+        moments.empty(), np.array([-5.0, -1.0, -10.0], dtype=np.float32)
+    )
+    got = np.asarray(moments.quantile(neg, np.array([0.0, 1.0])))
+    assert got[0] == -10.0 and got[1] == -1.0
+
+
+def test_moments_scale_robustness():
+    # huge magnitudes must not overflow the float32 power sums
+    from loghisto_tpu.models import moments
+
+    st = moments.insert(moments.empty(), np.array([1e30, 2e30, 3e30],
+                                                  dtype=np.float32))
+    for field in ("mean", "m2", "m3", "m4"):
+        assert np.isfinite(float(getattr(st, field)))
+    mean, std, _, _ = moments.standardized_moments(st)
+    assert abs(float(mean) / 2e30 - 1) < 1e-3
+
+
+def test_moments_no_cancellation_at_large_mean():
+    # mean >> std: raw power sums would cancel catastrophically; centered
+    # accumulation must keep std accurate
+    from loghisto_tpu.models import moments
+
+    rng = np.random.default_rng(8)
+    data = rng.normal(10_000.0, 1.0, 20_000).astype(np.float32)
+    st = moments.empty()
+    for chunk in np.split(data, 4):
+        st = moments.insert(st, chunk)
+    mean, std, skew, kurt = (
+        float(x) for x in moments.standardized_moments(st)
+    )
+    assert abs(mean - 10_000.0) < 0.1
+    assert abs(std - 1.0) < 0.05
+    got = np.asarray(moments.quantile(st, np.array([0.5, 0.99])))
+    want = np.quantile(data, [0.5, 0.99])
+    assert np.abs(got - want).max() < 0.5
+
+
+def test_moments_nan_pinned_to_zero():
+    from loghisto_tpu.models import moments
+
+    st = moments.insert(
+        moments.empty(),
+        np.array([4.0, np.nan, 8.0], dtype=np.float32),
+    )
+    assert int(moments.count(st)) == 3
+    mean, _, _, _ = moments.standardized_moments(st)
+    assert abs(float(mean) - 4.0) < 1e-5  # (4 + 0 + 8) / 3
+
+
 # --------------------------- LogHistogram --------------------------- #
 
 def test_loghistogram_model():
