@@ -1,0 +1,87 @@
+"""Compare the device ingest paths (scatter vs MXU matmul vs Pallas row)
+across metric counts — the tuning harness for picking per-config
+fast paths on real hardware.
+
+Usage: python benchmarks/device_paths.py [--batch 1048576] [--steps 8]
+       [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+# runnable from anywhere: add the repo root to sys.path
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+
+def bench_fn(fn, acc, args, steps):
+    import jax
+
+    out = fn(acc, *args)  # compile
+    jax.block_until_ready(out)
+    acc = out if not isinstance(out, tuple) else out[0]
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        acc = fn(acc, *args)
+    jax.block_until_ready(acc)
+    return time.perf_counter() - t0
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch", type=int, default=1 << 20)
+    parser.add_argument("--steps", type=int, default=8)
+    parser.add_argument("--bucket-limit", type=int, default=4096)
+    parser.add_argument("--cpu", action="store_true")
+    args = parser.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from loghisto_tpu.config import MetricConfig
+    from loghisto_tpu.ops.ingest import make_ingest_fn
+    from loghisto_tpu.ops.matmul_hist import make_matmul_ingest_fn
+    from loghisto_tpu.ops.pallas_kernels import (
+        SAMPLE_TILE,
+        make_pallas_row_ingest,
+    )
+
+    cfg = MetricConfig(bucket_limit=args.bucket_limit)
+    rng = np.random.default_rng(0)
+    n = args.batch // SAMPLE_TILE * SAMPLE_TILE
+    values = rng.lognormal(8, 2, n).astype(np.float32)
+    print(f"platform={jax.devices()[0].platform} batch={n} "
+          f"steps={args.steps} buckets={cfg.num_buckets}")
+    print(f"{'M':>6} {'path':>10} {'samples/s':>14}")
+
+    for m in (1, 16, 256, 10_000):
+        ids = rng.integers(0, m, n).astype(np.int32)
+        acc = jnp.zeros((m, cfg.num_buckets), dtype=jnp.int32)
+        scatter = make_ingest_fn(cfg.bucket_limit)
+        dt = bench_fn(scatter, acc, (ids, values), args.steps)
+        print(f"{m:>6} {'scatter':>10} {n*args.steps/dt:>14.3e}")
+
+        if m * cfg.num_buckets <= 1 << 23:
+            acc = jnp.zeros((m, cfg.num_buckets), dtype=jnp.int32)
+            matmul = make_matmul_ingest_fn(cfg.bucket_limit)
+            dt = bench_fn(matmul, acc, (ids, values), args.steps)
+            print(f"{m:>6} {'matmul':>10} {n*args.steps/dt:>14.3e}")
+
+        if m == 1:
+            row = jnp.zeros(cfg.num_buckets, dtype=jnp.int32)
+            pal = make_pallas_row_ingest(cfg.num_buckets, cfg.bucket_limit)
+            dt = bench_fn(pal, row, (values,), args.steps)
+            print(f"{m:>6} {'pallas':>10} {n*args.steps/dt:>14.3e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Host-tier ingest micro-benchmarks: the Python hot path (the analog of
+the reference's 20M samples/s Go headline) and the native staging buffer.
+
+Usage: python benchmarks/host_ingest.py [--threads 4] [--seconds 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+
+import numpy as np
+
+# runnable from anywhere: add the repo root to sys.path
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--threads", type=int, default=4)
+    parser.add_argument("--seconds", type=float, default=2.0)
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from loghisto_tpu import MetricSystem
+    from loghisto_tpu import _native
+
+    ms = MetricSystem(interval=3600, sys_stats=False)
+
+    def run_threaded(op, label):
+        stop = threading.Event()
+        counts = [0] * args.threads
+
+        def worker(k):
+            while not stop.is_set():
+                op()
+                counts[k] += 1
+
+        threads = [
+            threading.Thread(target=worker, args=(k,))
+            for k in range(args.threads)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(args.seconds)
+        stop.set()
+        for t in threads:
+            t.join()
+        rate = sum(counts) / args.seconds
+        print(f"{label:>28}: {rate/1e6:>8.2f}M ops/s "
+              f"({args.threads} threads)")
+        return rate
+
+    run_threaded(lambda: ms.counter("c", 1), "counter")
+    run_threaded(lambda: ms.histogram("h", 42.0), "histogram")
+
+    def timer_op():
+        ms.start_timer("t").stop()
+
+    run_threaded(timer_op, "start_timer/stop")
+
+    batch_ids = np.zeros(10_000, dtype=np.int32)
+    batch_vals = np.full(10_000, 42.0)
+
+    def batch_op():
+        ms.histogram_batch("hb", batch_vals)
+
+    stop = threading.Event()
+    n = [0]
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < args.seconds:
+        batch_op()
+        n[0] += len(batch_vals)
+    print(f"{'histogram_batch(10k)':>28}: "
+          f"{n[0]/args.seconds/1e6:>8.2f}M samples/s (1 thread)")
+    ms.collect_raw_metrics()  # drain
+
+    if _native.available():
+        buf = _native.NativeIngestBuffer(
+            num_shards=max(4, args.threads), capacity_per_shard=1 << 22
+        )
+        t0 = time.perf_counter()
+        sent = 0
+        while time.perf_counter() - t0 < args.seconds:
+            buf.record_batch(batch_ids, batch_vals.astype(np.float64))
+            sent += len(batch_ids)
+            if sent % (1 << 22) == 0:
+                buf.drain()
+        print(f"{'native record_batch(10k)':>28}: "
+              f"{sent/args.seconds/1e6:>8.2f}M samples/s (1 thread)")
+        buf.close()
+    else:
+        print("native staging unavailable:", _native.build_error())
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,9 @@ def _flat_cells(ids, values, num_buckets, bucket_limit, precision):
     return ids * num_buckets + bidx
 
 
+CHUNK = 4096  # samples per one-hot matmul; bounds the [CHUNK, H] one-hot
+
+
 def ingest_batch_matmul(
     acc: jnp.ndarray,
     ids: jnp.ndarray,
@@ -47,7 +50,11 @@ def ingest_batch_matmul(
 ) -> jnp.ndarray:
     """Accumulate one (ids, values) batch into acc[M, B] via one-hot
     matmuls.  Semantically identical to ops.ingest.ingest_batch for
-    in-range ids; out-of-range ids are dropped."""
+    in-range ids; out-of-range ids are dropped.
+
+    The batch is processed in CHUNK-sized pieces under lax.scan so the
+    materialized one-hots stay [CHUNK, H] regardless of N; the float32
+    count accumulator is exact for per-batch cell counts < 2^24."""
     m, b = acc.shape
     n = values.shape[0]
     flat = _flat_cells(ids, values, b, bucket_limit, precision)
@@ -57,13 +64,27 @@ def ingest_batch_matmul(
     hi = jnp.where(valid, flat // LANES, h)  # h = one-past-end: drops
     lo = jnp.where(valid, flat % LANES, 0)
 
-    onehot_hi = jax.nn.one_hot(hi, h, dtype=jnp.bfloat16)  # [N, H]
-    onehot_lo = jax.nn.one_hot(lo, LANES, dtype=jnp.bfloat16)  # [N, 128]
-    counts = jax.lax.dot_general(
-        onehot_hi, onehot_lo,
-        dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [H, 128], exact integers below 2^24
+    pad = (n + CHUNK - 1) // CHUNK * CHUNK - n
+    if pad:
+        hi = jnp.concatenate([hi, jnp.full(pad, h, dtype=hi.dtype)])
+        lo = jnp.concatenate([lo, jnp.zeros(pad, dtype=lo.dtype)])
+    g = hi.shape[0] // CHUNK
+
+    def body(counts, chunk):
+        chi, clo = chunk
+        onehot_hi = jax.nn.one_hot(chi, h, dtype=jnp.bfloat16)  # [C, H]
+        onehot_lo = jax.nn.one_hot(clo, LANES, dtype=jnp.bfloat16)
+        partial = jax.lax.dot_general(
+            onehot_hi, onehot_lo,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [H, 128]
+        return counts + partial, None
+
+    counts = jnp.zeros((h, LANES), dtype=jnp.float32)
+    counts, _ = jax.lax.scan(
+        body, counts, (hi.reshape(g, CHUNK), lo.reshape(g, CHUNK))
+    )
     counts = counts.astype(jnp.int32).reshape(-1)[:total].reshape(m, b)
     return acc + counts
 

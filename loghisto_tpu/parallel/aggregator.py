@@ -197,6 +197,12 @@ class TPUAggregator:
 
         self._native_buf = None
         self._native_staged = 0
+        # host-side retry buffer bound when the device is unreachable
+        self.max_pending_samples = 32 * batch_size
+        self.retry_cooldown = 1.0  # seconds between device retry attempts
+        self._shed_samples = 0
+        self._device_down_until = 0.0
+        self._interval_ingested = 0  # samples in the live accumulator
         if native_staging:
             from loghisto_tpu import _native
 
@@ -278,16 +284,54 @@ class TPUAggregator:
             self._pending_ids.append(ids)
             self._pending_values.append(values)
             self._pending_count += len(ids)
+            # while the device is down (flush cooldown-gated), the buffer
+            # must stay bounded
+            self._bound_pending_locked()
             should_flush = self._pending_count >= self.batch_size
         if should_flush:
             self.flush()
 
-    def flush(self) -> None:
+    def _fresh_acc(self) -> jnp.ndarray:
+        if self.mesh is not None:
+            return make_sharded_accumulator(
+                self.mesh, self.num_metrics, self.config.num_buckets
+            )
+        return jnp.zeros(
+            (self.num_metrics, self.config.num_buckets), dtype=jnp.int32
+        )
+
+    def _bound_pending_locked(self) -> None:
+        """Enforce max_pending_samples by shedding the OLDEST samples,
+        slicing partial arrays so no more than the overflow is dropped.
+        Caller holds self._lock."""
+        overflow = self._pending_count - self.max_pending_samples
+        while overflow > 0 and self._pending_ids:
+            head = self._pending_ids[0]
+            if len(head) <= overflow:
+                self._pending_ids.pop(0)
+                self._pending_values.pop(0)
+                self._pending_count -= len(head)
+                self._shed_samples += len(head)
+                overflow -= len(head)
+            else:
+                self._pending_ids[0] = head[overflow:]
+                self._pending_values[0] = self._pending_values[0][overflow:]
+                self._pending_count -= overflow
+                self._shed_samples += overflow
+                overflow = 0
+
+    def flush(self, force: bool = False) -> None:
         """Push buffered samples to the device accumulator.
 
         Batches are shipped in fixed-size chunks (padding the tail with
         id -1, which the kernel drops) so the jitted ingest compiles for
-        exactly one shape instead of one executable per batch length."""
+        exactly one shape instead of one executable per batch length.
+
+        Device failures follow SURVEY.md §5.3 shed-don't-block: samples
+        buffer on host (bounded, oldest shed first) and retries are
+        cooldown-gated so a down device costs one attempt per
+        retry_cooldown, not one per record.  `force=True` (used by
+        collect()) bypasses the cooldown."""
         if self._native_buf is not None:
             with self._lock:
                 self._native_staged = 0
@@ -297,9 +341,12 @@ class TPUAggregator:
                     self._pending_ids.append(nids)
                     self._pending_values.append(nvalues.astype(np.float32))
                     self._pending_count += len(nids)
+                    self._bound_pending_locked()
         with self._lock:
             if not self._pending_count:
                 return
+            if not force and time.monotonic() < self._device_down_until:
+                return  # device cooling down; keep buffering
             ids = np.concatenate(self._pending_ids)
             values = np.concatenate(self._pending_values)
             self._pending_ids, self._pending_values = [], []
@@ -315,9 +362,44 @@ class TPUAggregator:
                     [values, np.zeros(padded - n, dtype=np.float32)]
                 )
             for off in range(0, padded, bs):
-                self._acc = self._ingest(
-                    self._acc, ids[off:off + bs], values[off:off + bs]
-                )
+                try:
+                    self._acc = self._ingest(
+                        self._acc, ids[off:off + bs], values[off:off + bs]
+                    )
+                    self._device_down_until = 0.0
+                    self._interval_ingested += min(bs, n - off)
+                except Exception:
+                    import logging
+
+                    logger = logging.getLogger("loghisto_tpu")
+                    self._device_down_until = (
+                        time.monotonic() + self.retry_cooldown
+                    )
+                    # The ingest donates the accumulator; a failure may
+                    # have consumed the buffer.  Detect it — continuing to
+                    # use a deleted array would brick every later flush.
+                    if getattr(self._acc, "is_deleted", lambda: False)():
+                        logger.error(
+                            "device failure consumed the donated "
+                            "accumulator; %d already-ingested samples of "
+                            "this interval are lost",
+                            self._interval_ingested,
+                        )
+                        self._shed_samples += self._interval_ingested
+                        self._interval_ingested = 0
+                        self._acc = self._fresh_acc()
+                    tail = n - off  # real samples only, never the pad
+                    logger.exception(
+                        "device ingest failed; buffering %d samples for "
+                        "retry (cooldown %.1fs)", max(tail, 0),
+                        self.retry_cooldown,
+                    )
+                    if tail > 0:
+                        self._pending_ids.append(ids[off:n])
+                        self._pending_values.append(values[off:n])
+                        self._pending_count += tail
+                    self._bound_pending_locked()
+                    break
 
     # -- host-tier bridge ----------------------------------------------- #
 
@@ -385,7 +467,7 @@ class TPUAggregator:
     def collect(self, reset: bool = True) -> ProcessedMetricSet:
         """Extract statistics for every registered metric on device and
         return them with the standard naming scheme."""
-        self.flush()
+        self.flush(force=True)
         labels, ps = [], []
         for label, p in self.percentiles.items():
             if 0.0 <= p <= 1.0:
@@ -402,6 +484,7 @@ class TPUAggregator:
             if reset:
                 # zeros_like preserves the NamedSharding in mesh mode
                 self._acc = jnp.zeros_like(acc)
+                self._interval_ingested = 0
             else:
                 acc = acc + 0  # defensive copy; donation-safe snapshot
         from loghisto_tpu.utils.trace import maybe_capture
@@ -474,3 +557,6 @@ class TPUAggregator:
                 "tpu.StagingDropped",
                 lambda: float(self._native_buf.dropped),
             )
+        ms.register_gauge_func(
+            "tpu.SamplesShed", lambda: float(self._shed_samples)
+        )

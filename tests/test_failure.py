@@ -1,0 +1,104 @@
+"""Failure injection: device ingest failures must buffer-and-retry on
+host with bounded memory (SURVEY.md §5.3), never block or lose silently
+within the bound."""
+
+import numpy as np
+import pytest
+
+from loghisto_tpu.config import MetricConfig
+from loghisto_tpu.parallel.aggregator import TPUAggregator
+
+CFG = MetricConfig(bucket_limit=256)
+
+
+class _FlakyIngest:
+    """Wraps the real ingest fn; fails the first `failures` calls."""
+
+    def __init__(self, real, failures):
+        self.real = real
+        self.remaining = failures
+        self.calls = 0
+
+    def __call__(self, acc, ids, values):
+        self.calls += 1
+        if self.remaining > 0:
+            self.remaining -= 1
+            raise RuntimeError("injected device failure")
+        return self.real(acc, ids, values)
+
+
+def test_device_failure_buffers_and_retries():
+    agg = TPUAggregator(num_metrics=4, config=CFG, batch_size=256)
+    agg.retry_cooldown = 0.0  # retry every attempt in tests
+    agg.registry.id_for("m")
+    flaky = _FlakyIngest(agg._ingest, failures=2)
+    agg._ingest = flaky
+
+    agg.record_batch(
+        np.zeros(100, dtype=np.int32), np.full(100, 5.0, dtype=np.float32)
+    )
+    agg.flush()  # fails; samples buffered
+    assert agg._pending_count > 0
+    agg.flush()  # fails again; still buffered
+    out = agg.collect().metrics  # collect's flush succeeds (3rd call)
+    assert out["m_count"] == 100  # nothing lost within the bound
+    assert agg._shed_samples == 0
+
+
+def test_device_failure_cooldown_gates_retries():
+    agg = TPUAggregator(num_metrics=4, config=CFG, batch_size=64)
+    agg.retry_cooldown = 60.0
+    agg.registry.id_for("m")
+    flaky = _FlakyIngest(agg._ingest, failures=10**9)
+    agg._ingest = flaky
+    for _ in range(5):
+        agg.record_batch(
+            np.zeros(64, dtype=np.int32), np.full(64, 5.0, dtype=np.float32)
+        )
+    # one failed attempt, then the cooldown swallows the rest
+    assert flaky.calls == 1
+    assert agg._pending_count == 5 * 64  # nothing lost, all buffered
+
+
+def test_pad_never_enters_retry_buffer():
+    agg = TPUAggregator(num_metrics=4, config=CFG, batch_size=256)
+    agg.retry_cooldown = 0.0
+    agg.registry.id_for("m")
+    agg._ingest = _FlakyIngest(agg._ingest, failures=1)
+    agg.record_batch(
+        np.zeros(100, dtype=np.int32), np.full(100, 5.0, dtype=np.float32)
+    )
+    agg.flush()  # fails: 100 real samples requeued, 156 pad entries not
+    assert agg._pending_count == 100
+    out = agg.collect().metrics
+    assert out["m_count"] == 100
+
+
+def test_bounded_shedding_is_exact():
+    agg = TPUAggregator(num_metrics=4, config=CFG, batch_size=64)
+    agg.retry_cooldown = 0.0
+    agg.max_pending_samples = 100
+    agg.registry.id_for("m")
+    agg._ingest = _FlakyIngest(agg._ingest, failures=10**9)
+    agg.record_batch(
+        np.zeros(256, dtype=np.int32), np.full(256, 5.0, dtype=np.float32)
+    )
+    # bound holds exactly: only the overflow is shed, the cap is retained
+    assert agg._pending_count == 100
+    assert agg._shed_samples == 156
+
+
+def test_device_failure_sheds_beyond_bound():
+    agg = TPUAggregator(num_metrics=4, config=CFG, batch_size=64)
+    agg.registry.id_for("m")
+    agg.max_pending_samples = 128
+    agg._ingest = _FlakyIngest(agg._ingest, failures=10**9)  # always down
+
+    for _ in range(10):
+        agg.record_batch(
+            np.zeros(64, dtype=np.int32), np.full(64, 5.0, dtype=np.float32)
+        )
+    assert agg._pending_count <= agg.max_pending_samples
+    assert agg._shed_samples > 0  # overflow shed, loudly countable
+    # accounting is exact: buffered + shed == recorded
+    assert agg._pending_count + agg._shed_samples == 10 * 64
