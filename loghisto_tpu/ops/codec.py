@@ -30,7 +30,10 @@ from loghisto_tpu.config import INT16_BUCKET_LIMIT, PRECISION
 
 
 def compress_scalar(value: float, precision: int = PRECISION) -> int:
-    """Scalar compress with exact reference semantics (metrics.go:316-322)."""
+    """Scalar compress with exact reference semantics (metrics.go:316-322).
+    NaN pins to bucket 0, like every other tier."""
+    if math.isnan(value):
+        return 0
     i = int(precision * math.log1p(abs(value)) + 0.5)  # floor: arg is >= 0
     i = min(i, INT16_BUCKET_LIMIT)
     return -i if value < 0 else i
@@ -43,8 +46,10 @@ def decompress_scalar(bucket: int, precision: int = PRECISION) -> float:
 
 
 def compress_np(values: np.ndarray, precision: int = PRECISION) -> np.ndarray:
-    """Vectorized compress -> int16 buckets (host tier)."""
+    """Vectorized compress -> int16 buckets (host tier).  NaN pins to
+    bucket 0, like every other tier."""
     values = np.asarray(values, dtype=np.float64)
+    values = np.where(np.isnan(values), 0.0, values)
     mag = np.floor(precision * np.log1p(np.abs(values)) + 0.5)
     mag = np.minimum(mag, INT16_BUCKET_LIMIT)
     return np.where(values < 0, -mag, mag).astype(np.int16)
@@ -59,8 +64,10 @@ def decompress_np(buckets: np.ndarray, precision: int = PRECISION) -> np.ndarray
 
 def compress(values: jnp.ndarray, precision: int = PRECISION) -> jnp.ndarray:
     """Vectorized compress on device (int32 buckets — int16 only matters for
-    storage; the dense accumulator indexes with int32 anyway)."""
+    storage; the dense accumulator indexes with int32 anyway).  NaN pins
+    to bucket 0, like every other tier."""
     values = jnp.asarray(values)
+    values = jnp.where(jnp.isnan(values), 0.0, values)
     mag = jnp.floor(precision * jnp.log1p(jnp.abs(values)) + 0.5)
     mag = jnp.minimum(mag, float(INT16_BUCKET_LIMIT))
     return jnp.where(values < 0, -mag, mag).astype(jnp.int32)

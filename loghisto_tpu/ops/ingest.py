@@ -29,10 +29,7 @@ def bucket_indices(
     values: jnp.ndarray, bucket_limit: int, precision: int = PRECISION
 ) -> jnp.ndarray:
     """values -> clipped dense bucket-axis indices in [0, 2*bucket_limit].
-
-    NaN samples land in the zero bucket (float->int of NaN is otherwise
-    platform-defined; pinning it keeps device and host tiers agreeing)."""
-    values = jnp.where(jnp.isnan(values), 0.0, values)
+    (NaN pinning to bucket 0 happens inside compress.)"""
     buckets = compress(values, precision)
     return jnp.clip(buckets, -bucket_limit, bucket_limit) + bucket_limit
 

@@ -92,6 +92,12 @@ def test_negative_values_mirror():
         assert decompress_scalar(-b) == -decompress_scalar(b)
 
 
+def test_nan_pins_to_bucket_zero_every_tier():
+    assert compress_scalar(float("nan")) == 0
+    assert compress_np(np.array([np.nan]))[0] == 0
+    assert int(np.asarray(compress(np.array([np.nan], dtype=np.float32)))[0]) == 0
+
+
 def test_saturation_instead_of_wrap():
     # Deviation from Go (documented in codec.py): beyond ~1e142 we saturate.
     assert compress_scalar(1e300) == 32767
