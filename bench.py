@@ -66,10 +66,10 @@ def _start_watchdog(timeout_s: float = 420.0):
     return ready
 
 
-def _probe_device(timeout_s: float = 240.0) -> bool:
+def _probe_device(timeout_s: float = 240.0) -> str | None:
     """Check device availability in a SUBPROCESS (a hung PJRT client init
-    cannot be interrupted in-process).  Returns True when the configured
-    platform initializes within the timeout."""
+    cannot be interrupted in-process).  Returns None when the configured
+    platform initializes within the timeout, else a reason string."""
     import subprocess
     import sys
 
@@ -79,23 +79,38 @@ def _probe_device(timeout_s: float = 240.0) -> bool:
              "import jax; print(jax.devices()[0].platform)"],
             capture_output=True, text=True, timeout=timeout_s,
         )
-        return proc.returncode == 0
     except subprocess.TimeoutExpired:
-        return False
+        return f"device init hung for {timeout_s}s"
+    if proc.returncode != 0:
+        return (
+            f"device init failed (exit {proc.returncode}): "
+            f"{proc.stderr.strip()[-500:]}"
+        )
+    return None
 
 
 def main() -> None:
-    ready = _start_watchdog()
+    import os
+    import sys
+
     import jax
 
-    if not _probe_device():
-        # TPU tunnel wedged: fall back to CPU so the driver still gets a
-        # result line; the "platform" field discloses the downgrade.
-        print(
-            "bench: device init probe timed out; falling back to CPU",
-            file=__import__("sys").stderr,
-        )
-        jax.config.update("jax_platforms", "cpu")
+    # The hang-then-fallback dance only applies to the tunneled axon TPU
+    # platform; anywhere else the probe would just double the init cost.
+    wedge_possible = "axon" in os.environ.get(
+        "JAX_PLATFORMS", ""
+    ) or os.environ.get("PALLAS_AXON_POOL_IPS")
+    if wedge_possible:
+        reason = _probe_device()
+        if reason is not None:
+            # Fall back to CPU so the driver still gets a result line; the
+            # "platform" field discloses the downgrade.
+            print(f"bench: {reason}; falling back to CPU", file=sys.stderr)
+            jax.config.update("jax_platforms", "cpu")
+
+    # Arm the watchdog only after the probe so the fallback gets the full
+    # window for its own compile.
+    ready = _start_watchdog()
 
     import jax.numpy as jnp
 

@@ -12,6 +12,7 @@ from loghisto_tpu.metrics import (
     RawMetricSet,
     TimerToken,
 )
+from loghisto_tpu.system import TPUMetricSystem
 
 __version__ = "0.1.0"
 
@@ -29,5 +30,6 @@ __all__ = [
     "Metrics",
     "ProcessedMetricSet",
     "RawMetricSet",
+    "TPUMetricSystem",
     "TimerToken",
 ]

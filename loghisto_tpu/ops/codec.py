@@ -34,6 +34,8 @@ def compress_scalar(value: float, precision: int = PRECISION) -> int:
     NaN pins to bucket 0, like every other tier."""
     if math.isnan(value):
         return 0
+    if math.isinf(value):  # saturate like the vectorized tiers
+        return -INT16_BUCKET_LIMIT if value < 0 else INT16_BUCKET_LIMIT
     i = int(precision * math.log1p(abs(value)) + 0.5)  # floor: arg is >= 0
     i = min(i, INT16_BUCKET_LIMIT)
     return -i if value < 0 else i

@@ -1,0 +1,73 @@
+"""TPUMetricSystem: the fully wired product in one object.
+
+A drop-in MetricSystem whose aggregation also runs on device: it
+constructs a TPUAggregator, attaches it behind the subscription boundary
+(the north-star architecture — callers keep using counter/histogram/
+start_timer unchanged), registers the TPU gauges, and exposes the
+device-side statistics.
+
+    ms = TPUMetricSystem(interval=1.0, num_metrics=10_000)
+    ms.start()
+    ms.histogram("rpc_latency", 1234.5)        # host path, as ever
+    ms.record_batch(ids, values)               # firehose path, batched
+    pms = ms.device_metrics()                  # percentiles computed on TPU
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+import numpy as np
+
+from loghisto_tpu.config import DEFAULT_PERCENTILES, MetricConfig
+from loghisto_tpu.metrics import MetricSystem, ProcessedMetricSet
+from loghisto_tpu.parallel.aggregator import TPUAggregator
+
+
+class TPUMetricSystem(MetricSystem):
+    def __init__(
+        self,
+        interval: float = 60.0,
+        sys_stats: bool = True,
+        config: MetricConfig = MetricConfig(),
+        num_metrics: int = 1024,
+        percentiles: Mapping[str, float] = DEFAULT_PERCENTILES,
+        mesh=None,
+        native_staging: bool = False,
+    ):
+        super().__init__(
+            interval=interval, sys_stats=sys_stats, config=config
+        )
+        self.aggregator = TPUAggregator(
+            num_metrics=num_metrics,
+            config=config,
+            percentiles=percentiles,
+            mesh=mesh,
+            native_staging=native_staging,
+        )
+        self.aggregator.attach(self)
+        self.aggregator.register_device_gauges(self)
+
+    def record_batch(self, ids: np.ndarray, values: np.ndarray) -> None:
+        """Batched firehose ingestion straight to the device accumulator
+        (bypasses the host sparse tier; ids come from metric_id())."""
+        self.aggregator.record_batch(ids, values)
+
+    def metric_id(self, name: str) -> int:
+        """Dense row id for `name` (registers on first use)."""
+        return self.aggregator.registry.id_for(name)
+
+    def device_metrics(self, reset: bool = True) -> ProcessedMetricSet:
+        """Device-side statistics for everything aggregated so far."""
+        return self.aggregator.collect(reset=reset)
+
+    def start(self) -> None:
+        # restartable like the base class: re-attach the device bridge if a
+        # previous stop() detached it
+        if self.aggregator._attached is None:
+            self.aggregator.attach(self)
+        super().start()
+
+    def stop(self) -> None:
+        self.aggregator.detach()
+        super().stop()

@@ -1,0 +1,87 @@
+"""TPUMetricSystem end-to-end: host API in, device statistics out."""
+
+import time
+
+import numpy as np
+import pytest
+
+from loghisto_tpu.config import MetricConfig
+from loghisto_tpu.system import TPUMetricSystem
+
+CFG = MetricConfig(bucket_limit=1024)
+
+
+def test_host_api_reaches_device():
+    ms = TPUMetricSystem(
+        interval=0.05, sys_stats=False, config=CFG, num_metrics=8
+    )
+    for v in (10.0, 20.0, 30.0):
+        ms.histogram("lat", v)
+    ms.start()
+    try:
+        deadline = time.time() + 5
+        out = {}
+        while time.time() < deadline:
+            out = ms.device_metrics(reset=False).metrics
+            if out.get("lat_count") == 3:
+                break
+            time.sleep(0.05)
+        assert out.get("lat_count") == 3
+        assert abs(out["lat_avg"] / 20.0 - 1) < 0.02
+    finally:
+        ms.stop()
+
+
+def test_firehose_path_and_gauges():
+    ms = TPUMetricSystem(
+        interval=0.05, sys_stats=False, config=CFG, num_metrics=8
+    )
+    rid = ms.metric_id("rpc")
+    ms.record_batch(
+        np.full(1000, rid, dtype=np.int32),
+        np.full(1000, 50.0, dtype=np.float32),
+    )
+    out = ms.device_metrics().metrics
+    assert out["rpc_count"] == 1000
+    gauges = ms.collect_raw_metrics().gauges
+    assert "tpu.HbmBytesInUse" in gauges
+    assert "tpu.SamplesShed" in gauges
+    ms.stop()
+
+
+def test_restart_reattaches_bridge():
+    ms = TPUMetricSystem(
+        interval=0.05, sys_stats=False, config=CFG, num_metrics=8
+    )
+    ms.start()
+    ms.stop()
+    ms.start()  # must re-attach the device bridge
+    try:
+        ms.histogram("post_restart", 7.0)
+        deadline = time.time() + 5
+        out = {}
+        while time.time() < deadline:
+            out = ms.device_metrics(reset=False).metrics
+            if out.get("post_restart_count") == 1:
+                break
+            time.sleep(0.05)
+        assert out.get("post_restart_count") == 1
+    finally:
+        ms.stop()
+
+
+def test_codec_scalar_inf_saturates():
+    from loghisto_tpu.ops.codec import compress_scalar
+
+    assert compress_scalar(float("inf")) == 32767
+    assert compress_scalar(float("-inf")) == -32767
+
+
+def test_stop_detaches_cleanly():
+    ms = TPUMetricSystem(
+        interval=0.05, sys_stats=False, config=CFG, num_metrics=8
+    )
+    ms.start()
+    time.sleep(0.1)
+    ms.stop()  # must not hang or leak the bridge thread
+    assert ms.aggregator._attached is None
