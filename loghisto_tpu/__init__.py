@@ -11,6 +11,7 @@ from loghisto_tpu.metrics import (
     ProcessedMetricSet,
     RawMetricSet,
     TimerToken,
+    merge_raw_metric_sets,
 )
 from loghisto_tpu.system import TPUMetricSystem
 
@@ -32,4 +33,5 @@ __all__ = [
     "RawMetricSet",
     "TPUMetricSystem",
     "TimerToken",
+    "merge_raw_metric_sets",
 ]

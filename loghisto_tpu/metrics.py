@@ -87,6 +87,37 @@ class ProcessedMetricSet:
     metrics: Dict[str, float]
 
 
+def merge_raw_metric_sets(a: RawMetricSet, b: RawMetricSet) -> RawMetricSet:
+    """Merge two RawMetricSets — e.g. the same interval collected by two
+    processes/hosts.  Counters/rates add, histograms merge bucket-wise
+    (the exact mergeability the device tier rides via psum), gauges keep
+    the second argument's value on collision (gauges are point samples
+    and don't add).  The earlier timestamp wins (both are
+    interval-floored, so same-interval merges keep their boundary)."""
+    counters = dict(a.counters)
+    for name, v in b.counters.items():
+        counters[name] = counters.get(name, 0) + v
+    rates = dict(a.rates)
+    for name, v in b.rates.items():
+        rates[name] = rates.get(name, 0) + v
+    histograms: Dict[str, Dict[int, int]] = {
+        name: dict(buckets) for name, buckets in a.histograms.items()
+    }
+    for name, buckets in b.histograms.items():
+        _merge_counts(
+            histograms.setdefault(name, {}), buckets.keys(), buckets.values()
+        )
+    gauges = dict(a.gauges)
+    gauges.update(b.gauges)
+    return RawMetricSet(
+        time=min(a.time, b.time),
+        counters=counters,
+        rates=rates,
+        histograms=histograms,
+        gauges=gauges,
+    )
+
+
 class TimerToken:
     """Concurrent named duration timing (reference metrics.go:62-67).
 

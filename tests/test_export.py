@@ -53,6 +53,39 @@ def test_opentsdb_custom_tags():
     assert out.decode().rstrip("\n").endswith("host=h1 dc=us-east")
 
 
+def test_serializers_full_metric_set_byte_shape():
+    # A realistic full ProcessedMetricSet (the PrintBenchmark metric list)
+    # serializes to one well-formed line per metric in both protocols.
+    metrics = {
+        "op_count": 16488.0,
+        "op_max": 3.982478339757623e07,
+        "op_99.99": 3.864778314316012e07,
+        "op_50": 469769.7083161708,
+        "op_sum": 9.975892639594093e09,
+        "op_agg_avg": 618937.0,
+        "sys.Alloc": 997328.0,
+        "sys.NumGoroutine": 26.0,
+    }
+    pms = _pms(metrics)
+    g = graphite_protocol(pms, hostname="h").decode()
+    o = opentsdb_protocol(pms, hostname="h").decode()
+    ts = int(TS.timestamp())
+    assert len(g.splitlines()) == len(metrics)
+    assert len(o.splitlines()) == len(metrics)
+    for line in g.splitlines():
+        parts = line.split(" ")
+        assert len(parts) == 3 and parts[0].startswith("cockroach.h.")
+        float(parts[1])  # parses
+        assert int(parts[2]) == ts
+    for line in o.splitlines():
+        parts = line.split(" ")
+        assert parts[0] == "put" and int(parts[2]) == ts
+        float(parts[3])
+        assert parts[4] == "host=h"
+    # %f renders the big sum in plain decimal like Go's fmt %f
+    assert "9975892639.594093" in g
+
+
 class _Collector(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True

@@ -181,6 +181,31 @@ def test_interval_floor():
     assert ts.timestamp() <= 123456789.5 < ts.timestamp() + 60.0
 
 
+def test_merge_raw_metric_sets():
+    from loghisto_tpu import merge_raw_metric_sets
+
+    a_ms = MetricSystem(interval=1e-6, sys_stats=False)
+    b_ms = MetricSystem(interval=1e-6, sys_stats=False)
+    a_ms.counter("reqs", 10)
+    b_ms.counter("reqs", 5)
+    b_ms.counter("only_b", 1)
+    for v in (33, 59):
+        a_ms.histogram("h", v)
+    b_ms.histogram("h", 330000)
+    a, b = a_ms.collect_raw_metrics(), b_ms.collect_raw_metrics()
+    merged = merge_raw_metric_sets(a, b)
+    assert merged.counters["reqs"] == 15
+    assert merged.counters["only_b"] == 1
+    # merged histogram carries the golden 331132 decompressed sum
+    out = a_ms.process_metrics(merged).metrics
+    assert int(out["h_sum"]) == 331132
+    assert out["h_count"] == 3
+    # merging is order-free
+    merged2 = merge_raw_metric_sets(b, a)
+    assert merged2.histograms == merged.histograms
+    assert merged2.counters == merged.counters
+
+
 def test_concurrent_ingest():
     import threading
 
