@@ -82,6 +82,17 @@ def main():
             dt = bench_fn(pal, row, (values,), args.steps)
             print(f"{m:>6} {'pallas':>10} {n*args.steps/dt:>14.3e}")
 
+        if m >= 16 and jax.devices()[0].platform == "tpu":
+            # metric-tiled pallas path (interpret mode is far too slow off
+            # TPU, and the pltpu lowering only targets TPU)
+            from loghisto_tpu.ops.pallas_multirow import make_multirow_ingest
+
+            init, mingest, _ = make_multirow_ingest(
+                m, cfg.bucket_limit, rows_tile=8
+            )
+            dt = bench_fn(mingest, init(), (ids, values), args.steps)
+            print(f"{m:>6} {'multirow':>10} {n*args.steps/dt:>14.3e}")
+
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,202 @@
+"""Multi-row Pallas TPU ingest: metric-tiled histogram accumulation.
+
+The general [num_metrics, num_buckets] scatter-add gives XLA little to
+tile.  This kernel restructures the batch so the MXU does the work:
+
+  1. (XLA preprocess, all static shapes) bucket the samples, group them
+     by *metric row block* (rows_tile consecutive rows) with a sort, and
+     lay them out so every SAMPLE_TILE-sized tile contains samples of
+     exactly one block — block segments are padded up to tile boundaries
+     with filler entries (row index == rows_tile, which the one-hot
+     drops).
+  2. (Pallas kernel) grid over sample tiles; a scalar-prefetched
+     `tile_block` array routes each tile's accumulator block: the aliased
+     acc block (rows_tile, padded_buckets) stays resident in VMEM across
+     the consecutive tiles of one block, each tile adding a
+     [rows_tile*H, 128] one-hot matmul (MXU) of its samples.
+
+HBM traffic per batch is the sorted sample layout in + each touched
+block in/out once — compare scatter's per-sample random access.  The
+sort itself is XLA's (fast on TPU), and the layout padding overhead is
+bounded by one tile per block.
+
+The accumulator lives in a lane-padded layout [M, H*128] (H =
+ceil(num_buckets/128)); `finalize` slices back to [M, num_buckets].
+Unlike the single-row kernel (whose f32 scratch spans the whole call),
+per-tile f32 accumulation here is bounded by SAMPLE_TILE before the int32
+cast, so exactness is limited only by int32 per-cell overflow at 2^31 —
+the same contract as the scatter path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from loghisto_tpu.config import PRECISION
+from loghisto_tpu.ops.ingest import bucket_indices
+from loghisto_tpu.ops.pallas_kernels import LANES, SAMPLE_TILE, _on_tpu
+
+
+def preprocess(
+    ids: jnp.ndarray,
+    values: jnp.ndarray,
+    num_metrics: int,
+    rows_tile: int,
+    bucket_limit: int,
+    precision: int = PRECISION,
+    sample_tile: int = SAMPLE_TILE,
+):
+    """Sort and block-pad one batch.
+
+    Returns (layout_rows [G*T], layout_bidx [G*T], tile_block [G]) where
+    G = ceil(N/T) + n_blocks (static): every tile's samples belong to one
+    block, filler entries carry row == rows_tile.
+    """
+    n = ids.shape[0]
+    t = sample_tile
+    n_blocks = num_metrics // rows_tile
+    g = (n + t - 1) // t + n_blocks
+
+    bidx = bucket_indices(values, bucket_limit, precision)
+    valid = (ids >= 0) & (ids < num_metrics)
+    block = jnp.where(valid, ids // rows_tile, n_blocks - 1)
+    row_in_block = jnp.where(
+        valid, ids - block * rows_tile, rows_tile  # filler drops in one-hot
+    )
+
+    order = jnp.argsort(block)
+    sorted_block = block[order]
+    sorted_row = row_in_block[order]
+    sorted_bidx = bidx[order]
+
+    counts = jnp.bincount(sorted_block, length=n_blocks)
+    tiles_per_block = (counts + t - 1) // t
+    start_tile = jnp.concatenate(
+        [jnp.zeros(1, dtype=tiles_per_block.dtype),
+         jnp.cumsum(tiles_per_block)[:-1]]
+    )
+    padded_start = start_tile * t  # sample-slot offset of each block
+    sample_start = jnp.concatenate(
+        [jnp.zeros(1, dtype=counts.dtype), jnp.cumsum(counts)[:-1]]
+    )
+    rank = jnp.arange(n) - sample_start[sorted_block]
+    dest = padded_start[sorted_block] + rank
+
+    layout_rows = jnp.full(g * t, rows_tile, dtype=jnp.int32)
+    layout_bidx = jnp.zeros(g * t, dtype=jnp.int32)
+    layout_rows = layout_rows.at[dest].set(sorted_row.astype(jnp.int32))
+    layout_bidx = layout_bidx.at[dest].set(sorted_bidx.astype(jnp.int32))
+
+    # tile -> block routing; tiles beyond the used range park on the last
+    # block (their entries are all filler)
+    tile_ids = jnp.arange(g)
+    tile_block = (
+        jnp.searchsorted(start_tile, tile_ids, side="right") - 1
+    ).astype(jnp.int32)
+    tile_block = jnp.clip(tile_block, 0, n_blocks - 1)
+    return layout_rows, layout_bidx, tile_block
+
+
+def _kernel(tile_block_ref, rows_ref, bidx_ref, acc_in_ref, acc_out_ref, *,
+            rows_tile: int, h: int):
+    i = pl.program_id(0)
+    rows = rows_ref[0, :]
+    bidx = bidx_ref[0, :]
+    hi = bidx // LANES
+    lo = bidx % LANES
+    col = rows * h + hi  # filler rows land at >= rows_tile*h -> one-hot 0
+    onehot_col = jax.nn.one_hot(col, rows_tile * h, dtype=jnp.bfloat16)
+    onehot_lo = jax.nn.one_hot(lo, LANES, dtype=jnp.bfloat16)
+    partial = jax.lax.dot_general(
+        onehot_col, onehot_lo,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).reshape(rows_tile, h * LANES).astype(jnp.int32)
+
+    # Consecutive tiles of one block keep the output block resident; the
+    # aliased INPUT block may be re-fetched stale on revisits, so it is
+    # only read on the block's first tile — afterwards accumulate in the
+    # resident output block.
+    first_visit = jnp.logical_or(
+        i == 0, tile_block_ref[i] != tile_block_ref[jnp.maximum(i - 1, 0)]
+    )
+
+    @pl.when(first_visit)
+    def _init():
+        acc_out_ref[:] = acc_in_ref[:] + partial
+
+    @pl.when(jnp.logical_not(first_visit))
+    def _accumulate():
+        acc_out_ref[:] = acc_out_ref[:] + partial
+
+
+def make_multirow_ingest(
+    num_metrics: int,
+    bucket_limit: int,
+    precision: int = PRECISION,
+    rows_tile: int = 8,
+    interpret: bool | None = None,
+):
+    """Build (init, ingest, finalize) for the metric-tiled Pallas path.
+
+      init() -> padded acc int32 [num_metrics, H*128]
+      ingest(acc, ids, values) -> acc     (jitted, donated acc)
+      finalize(acc) -> int32 [num_metrics, 2*bucket_limit+1]
+    """
+    if num_metrics % rows_tile:
+        raise ValueError(
+            f"num_metrics={num_metrics} must divide by rows_tile={rows_tile}"
+        )
+    if interpret is None:
+        interpret = not _on_tpu()
+    num_buckets = 2 * bucket_limit + 1
+    h = (num_buckets + LANES - 1) // LANES
+    b_pad = h * LANES
+
+    def init():
+        return jnp.zeros((num_metrics, b_pad), dtype=jnp.int32)
+
+    kernel = functools.partial(_kernel, rows_tile=rows_tile, h=h)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def ingest(acc, ids, values):
+        rows, bidx, tile_block = preprocess(
+            ids, values, num_metrics, rows_tile, bucket_limit, precision
+        )
+        g = tile_block.shape[0]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(g,),
+            in_specs=[
+                pl.BlockSpec((1, SAMPLE_TILE), lambda i, tb: (i, 0)),
+                pl.BlockSpec((1, SAMPLE_TILE), lambda i, tb: (i, 0)),
+                pl.BlockSpec((rows_tile, b_pad), lambda i, tb: (tb[i], 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (rows_tile, b_pad), lambda i, tb: (tb[i], 0)
+            ),
+        )
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((num_metrics, b_pad), jnp.int32),
+            # flattened input index incl. the scalar-prefetch operand:
+            # 0=tile_block, 1=rows, 2=bidx, 3=acc
+            input_output_aliases={3: 0},
+            interpret=interpret,
+        )(
+            tile_block,
+            rows.reshape(g, SAMPLE_TILE),
+            bidx.reshape(g, SAMPLE_TILE),
+            acc,
+        )
+
+    def finalize(acc):
+        return acc[:, :num_buckets]
+
+    return init, ingest, finalize
