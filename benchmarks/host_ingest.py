@@ -66,6 +66,17 @@ def main():
 
     run_threaded(timer_op, "start_timer/stop")
 
+    if _native.fastpath_available():
+        fast_ms = MetricSystem(
+            interval=3600, sys_stats=False, fast_ingest=True
+        )
+        run_threaded(
+            lambda: fast_ms.histogram("h", 42.0), "histogram (fast_ingest)"
+        )
+        fast_ms.collect_raw_metrics()
+    else:
+        print("fastpath unavailable:", _native._fastpath_error)
+
     batch_ids = np.zeros(10_000, dtype=np.int32)
     batch_vals = np.full(10_000, 42.0)
 

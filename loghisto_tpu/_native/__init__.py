@@ -17,23 +17,27 @@ import numpy as np
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "ingest.cpp")
 _LIB_PATH = os.path.join(_HERE, "libloghisto_ingest.so")
+_FASTPATH_SRC = os.path.join(_HERE, "fastpath.cpp")
+_FASTPATH_PATH = os.path.join(_HERE, "loghisto_fastpath.so")
 
 _lib = None
 _lib_lock = threading.Lock()
 _build_error: str | None = None
+_fastpath = None
+_fastpath_error: str | None = None
 
 
-def _build() -> str | None:
+def _compile(src: str, out_path: str, extra_flags: list[str]) -> str | None:
+    """Compile `src` to `out_path` via a private temp file + atomic
+    rename, so concurrent builders (e.g. pytest-xdist workers) can never
+    dlopen a half-written .so.  Returns an error string or None."""
     import tempfile
 
-    # Compile to a private temp path, then atomically rename: concurrent
-    # builders (e.g. pytest-xdist workers) can never dlopen a half-written
-    # .so.
     fd, tmp = tempfile.mkstemp(dir=_HERE, suffix=".so.tmp")
     os.close(fd)
     cmd = [
-        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-        "-o", tmp, _SRC,
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        *extra_flags, "-o", tmp, src,
     ]
     try:
         proc = subprocess.run(
@@ -41,7 +45,7 @@ def _build() -> str | None:
         )
         if proc.returncode != 0:
             return f"g++ failed: {proc.stderr[-2000:]}"
-        os.replace(tmp, _LIB_PATH)
+        os.replace(tmp, out_path)
         return None
     except (OSError, subprocess.TimeoutExpired) as e:
         return f"g++ invocation failed: {e}"
@@ -52,19 +56,26 @@ def _build() -> str | None:
             pass
 
 
+def _is_stale(lib_path: str, src: str) -> bool:
+    try:
+        return not os.path.exists(lib_path) or (
+            os.path.getmtime(lib_path) < os.path.getmtime(src)
+        )
+    except OSError:
+        # e.g. prebuilt .so shipped without the source: use it as-is
+        return not os.path.exists(lib_path)
+
+
+def _build() -> str | None:
+    return _compile(_SRC, _LIB_PATH, ["-march=native"])
+
+
 def _load():
     global _lib, _build_error
     with _lib_lock:
         if _lib is not None or _build_error is not None:
             return _lib
-        try:
-            stale = not os.path.exists(_LIB_PATH) or (
-                os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
-            )
-        except OSError:
-            # e.g. prebuilt .so shipped without the source: use it as-is
-            stale = not os.path.exists(_LIB_PATH)
-        if stale:
+        if _is_stale(_LIB_PATH, _SRC):
             _build_error = _build()
             if _build_error is not None:
                 return None
@@ -116,6 +127,47 @@ def _load():
 
 def available() -> bool:
     return _load() is not None
+
+
+def _load_fastpath():
+    """Build+import the METH_FASTCALL per-call ingest extension."""
+    global _fastpath, _fastpath_error
+    with _lib_lock:
+        if _fastpath is not None or _fastpath_error is not None:
+            return _fastpath
+        import sysconfig
+
+        if _is_stale(_FASTPATH_PATH, _FASTPATH_SRC):
+            include = sysconfig.get_paths()["include"]
+            _fastpath_error = _compile(
+                _FASTPATH_SRC, _FASTPATH_PATH, [f"-I{include}"]
+            )
+            if _fastpath_error is not None:
+                return None
+        try:
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "loghisto_fastpath", _FASTPATH_PATH
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception as e:  # ImportError, OSError
+            _fastpath_error = f"import failed: {e}"
+            return None
+        _fastpath = mod
+        return _fastpath
+
+
+def fastpath_available() -> bool:
+    return _load_fastpath() is not None
+
+
+def fastpath_module():
+    mod = _load_fastpath()
+    if mod is None:
+        raise RuntimeError(f"fastpath unavailable: {_fastpath_error}")
+    return mod
 
 
 def build_error() -> str | None:
