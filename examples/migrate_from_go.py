@@ -1,0 +1,62 @@
+"""Side-by-side migration example: Go loghisto -> loghisto_tpu.
+
+Go (the reference's readme example):
+
+    ms := loghisto.NewMetricSystem(60*time.Second, true)
+    ms.Start()
+    myMetricStream := make(chan *loghisto.ProcessedMetricSet, 2)
+    ms.SubscribeToProcessedMetrics(myMetricStream)
+    timeToken := ms.StartTimer("submit_metrics")
+    ms.Counter("range_splits", 1)
+    ms.Histogram("some_ipc_latency", 123)
+    timeToken.Stop()
+    processedMetricSet := <-myMetricStream
+
+Python, same semantics and metric names (this file runs):
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # demo runs anywhere
+
+from loghisto_tpu import Channel, MetricSystem
+
+ms = MetricSystem(interval=0.2, sys_stats=True)  # 60.0 in production
+ms.start()
+
+my_metric_stream = Channel(capacity=2)
+ms.subscribe_to_processed_metrics(my_metric_stream)
+
+time_token = ms.start_timer("submit_metrics")
+ms.counter("range_splits", 1)
+ms.histogram("some_ipc_latency", 123)
+time_token.stop()
+
+processed = my_metric_stream.get(timeout=5)
+
+for key in (
+    "range_splits",            # lifetime counter
+    "range_splits_rate",       # this interval's delta
+    "some_ipc_latency_99.9",   # percentiles...
+    "some_ipc_latency_max",
+    "some_ipc_latency_count",
+    "some_ipc_latency_agg_count",
+    "sys.NumGoroutine",        # thread count under the familiar name
+):
+    print(f"{key:32s} {processed.metrics.get(key, 0.0)}")
+
+ms.unsubscribe_from_processed_metrics(my_metric_stream)
+ms.stop()
+
+# The parts Go didn't have: run the same aggregation on a TPU mesh.
+#
+#   from loghisto_tpu import TPUMetricSystem
+#   ms = TPUMetricSystem(interval=60.0, num_metrics=10_000,
+#                        mesh=make_mesh())   # psum merges across chips
+#   ...same calls...
+#   print(ms.device_metrics().metrics["some_ipc_latency_99.99"])

@@ -18,7 +18,14 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "ingest.cpp")
 _LIB_PATH = os.path.join(_HERE, "libloghisto_ingest.so")
 _FASTPATH_SRC = os.path.join(_HERE, "fastpath.cpp")
-_FASTPATH_PATH = os.path.join(_HERE, "loghisto_fastpath.so")
+# ABI-tagged filename: a CPython extension built under one interpreter
+# must never be dlopened by another (unlike the ctypes lib above)
+import sysconfig as _sysconfig
+
+_FASTPATH_PATH = os.path.join(
+    _HERE, "loghisto_fastpath" + (_sysconfig.get_config_var("EXT_SUFFIX")
+                                  or ".so")
+)
 
 _lib = None
 _lib_lock = threading.Lock()

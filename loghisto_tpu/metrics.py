@@ -278,19 +278,22 @@ class MetricSystem:
         """Drain the C staging buffer and fold into sparse bucket counts —
         the fast-path analog of _fold_shard_buffer, keeping memory at
         O(buckets) and the buffer from ever filling in steady state."""
-        ids_b, vals_b, dropped = self._fastpath.drain(self._fast_buf)
-        new_dropped = int(dropped) - self._fast_dropped_total
+        with self._fast_lock:
+            # drain + drop accounting under the lock: concurrent folds
+            # would otherwise move the lifetime watermark backward and
+            # double-report sheds
+            ids_b, vals_b, dropped = self._fastpath.drain(self._fast_buf)
+            new_dropped = int(dropped) - self._fast_dropped_total
+            self._fast_dropped_total = int(dropped)
+            names = list(self._fast_names)
         if new_dropped > 0:
             logger.error(
                 "fast-ingest buffer overflowed; %d samples shed", new_dropped
             )
-        self._fast_dropped_total = int(dropped)
         if not ids_b:
             return
         fids = np.frombuffer(ids_b, dtype=np.int32)
         fvals = np.frombuffer(vals_b, dtype=np.float64)
-        with self._fast_lock:
-            names = list(self._fast_names)
         order = np.argsort(fids, kind="stable")
         fids_s, fvals_s = fids[order], fvals[order]
         uniq, starts = np.unique(fids_s, return_index=True)
@@ -487,16 +490,11 @@ class MetricSystem:
                 )
             counters = dict(self._counter_store)
 
-        def _as_f64(buf) -> np.ndarray:
-            if isinstance(buf, np.ndarray):
-                return buf
-            return np.frombuffer(buf, dtype=np.float64)
-
         histograms: Dict[str, Dict[int, int]] = folded_counts
         for name, bufs in hist_buffers.items():
             values = np.concatenate(
-                [_as_f64(b) for b in bufs]
-            ) if len(bufs) > 1 else _as_f64(bufs[0])
+                [np.frombuffer(b, dtype=np.float64) for b in bufs]
+            ) if len(bufs) > 1 else np.frombuffer(bufs[0], dtype=np.float64)
             buckets = compress_np(values, self.config.precision)
             uniq, cnt = np.unique(buckets, return_counts=True)
             _merge_counts(histograms.setdefault(name, {}), uniq, cnt)

@@ -122,16 +122,33 @@ def dense_stats(
     idx_min = jnp.argmax(populated, axis=1)
     idx_max = (num_buckets - 1) - jnp.argmax(populated[:, ::-1], axis=1)
 
-    def row_search(cdfn_row, lo, hi):
-        # 0 < p < 1: first bucket where cdf/total >= p (empty prefix buckets
-        # have cdf 0 < p, so the hit always lands on a populated bucket).
-        # p == 0 / p == 1: the reference iterates only *populated* buckets,
-        # so these mean first/last populated bucket — selected exactly.
-        pos = jnp.searchsorted(cdfn_row, ps, side="left")
-        pos = jnp.minimum(pos, num_buckets - 1)
-        return jnp.where(ps <= 0, lo, jnp.where(ps >= 1, hi, pos))
+    # 0 < p < 1: first bucket where cdf/total >= p (empty prefix buckets
+    # have cdf 0 < p, so the hit always lands on a populated bucket).
+    # Two equivalent formulations of "first index with cdfn >= p":
+    #   * TPU: an argmax reduction over a comparison — VPU-tiled vector
+    #     work, one [M, B] pass per percentile (P is small and static);
+    #     per-row binary search lowers poorly there.
+    #   * CPU/GPU: vmapped searchsorted (binary search), ~3x cheaper than
+    #     the full comparison passes.
+    # p == 0 / p == 1: the reference iterates only *populated* buckets, so
+    # these mean first/last populated bucket — selected exactly.
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cols = []
+        for k in range(ps.shape[0]):
+            p = ps[k]
+            pos = jnp.argmax(cdfn >= p, axis=1)
+            cols.append(
+                jnp.where(p <= 0, idx_min, jnp.where(p >= 1, idx_max, pos))
+            )
+        idx = jnp.stack(cols, axis=1)
+    else:
+        def row_search(cdfn_row, lo, hi):
+            pos = jnp.searchsorted(cdfn_row, ps, side="left")
+            pos = jnp.minimum(pos, num_buckets - 1)
+            return jnp.where(ps <= 0, lo, jnp.where(ps >= 1, hi, pos))
 
-    idx = jax.vmap(row_search)(cdfn, idx_min, idx_max)
+        idx = jax.vmap(row_search)(cdfn, idx_min, idx_max)
     pct = reps[idx]
     nonempty = (counts > 0)[:, None]
     return {

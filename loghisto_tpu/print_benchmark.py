@@ -53,12 +53,17 @@ def print_benchmark(
     duration: Optional[float] = None,
     interval: float = 1.0,
     out: TextIO = sys.stdout,
+    fast_ingest: bool = True,
 ) -> None:
     """Run `op` at `concurrency` and print statistics each interval.
 
     Blocks for `duration` seconds (forever when None, like the reference).
+    Uses the C-extension ingest fast path when available (pass
+    fast_ingest=False to benchmark the pure-Python hot path).
     """
-    ms = MetricSystem(interval=interval, sys_stats=True)
+    ms = MetricSystem(
+        interval=interval, sys_stats=True, fast_ingest=fast_ingest
+    )
     mc = Channel(1)
     ms.subscribe_to_processed_metrics(mc)
     ms.start()
@@ -126,6 +131,10 @@ def main(argv: Optional[list[str]] = None) -> None:
         help="run time (default: forever, like the reference)",
     )
     parser.add_argument("--interval", type=float, default=1.0)
+    parser.add_argument(
+        "--no-fast", action="store_true",
+        help="benchmark the pure-Python hot path",
+    )
     args = parser.parse_args(argv)
 
     def op() -> None:
@@ -134,6 +143,7 @@ def main(argv: Optional[list[str]] = None) -> None:
     print_benchmark(
         args.name, args.concurrency, op,
         duration=args.seconds, interval=args.interval,
+        fast_ingest=not args.no_fast,
     )
 
 
