@@ -96,10 +96,14 @@ def main() -> None:
     import jax
 
     # The hang-then-fallback dance only applies to the tunneled axon TPU
-    # platform; anywhere else the probe would just double the init cost.
-    wedge_possible = "axon" in os.environ.get(
+    # platform; anywhere else (including when the caller already selected
+    # CPU via jax.config) the probe would just double the init cost.
+    configured = jax.config.jax_platforms or os.environ.get(
         "JAX_PLATFORMS", ""
-    ) or os.environ.get("PALLAS_AXON_POOL_IPS")
+    )
+    wedge_possible = "axon" in configured or (
+        not configured and os.environ.get("PALLAS_AXON_POOL_IPS")
+    )
     if wedge_possible:
         reason = _probe_device()
         if reason is not None:

@@ -1,0 +1,44 @@
+"""The two artifacts the round driver consumes must always work:
+bench.py (one JSON line) and __graft_entry__ (entry + dryrun_multichip)."""
+
+import io
+import json
+import sys
+
+import jax
+import pytest
+
+
+def test_bench_main_emits_one_json_line(monkeypatch, capsys):
+    import bench
+
+    monkeypatch.setattr(bench, "BATCH", 1 << 14)
+    monkeypatch.setattr(bench, "STEPS", 2)
+    monkeypatch.setattr(bench, "STATS_EVERY", 1)
+    monkeypatch.setattr(bench, "NUM_METRICS", 64)
+    monkeypatch.setattr(bench, "BUCKET_LIMIT", 256)
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    payload = json.loads(out[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in payload
+    assert payload["value"] > 0
+    assert payload["unit"] == "samples/s"
+
+
+def test_graft_entry_compiles_and_runs():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    acc, stats = out
+    assert acc.shape[0] == 64
+    assert "percentiles" in stats
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 8])
+def test_dryrun_multichip(n):
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(n)
