@@ -30,13 +30,22 @@ import jax.numpy as jnp
 @dataclasses.dataclass(frozen=True)
 class TDigestConfig:
     capacity: int = 256  # centroid slots (static shape)
-    delta: float = 100.0  # compression: ~delta clusters after a pass
+    # compression parameter; the k1 scale spans delta/2 clusters, so the
+    # default fills ~80% of capacity (delta = 1.6 * capacity)
+    delta: float = 0.0  # 0 -> derived from capacity
 
     def __post_init__(self):
         if self.capacity < 8:
             raise ValueError("capacity must be >= 8")
+        if self.delta == 0.0:
+            object.__setattr__(self, "delta", 1.6 * self.capacity)
         if self.delta < 8:
             raise ValueError("delta must be >= 8")
+        if self.delta / 2 + 1 > self.capacity:
+            raise ValueError(
+                f"delta={self.delta} needs ~{int(self.delta // 2) + 1} "
+                f"cluster slots, more than capacity={self.capacity}"
+            )
 
 
 def empty(config: TDigestConfig = TDigestConfig()):
