@@ -186,9 +186,11 @@ class MetricSystem:
         num_shards: Optional[int] = None,
         fast_ingest: bool = False,
     ):
-        """`fast_ingest=True` routes per-call histogram samples through the
-        C-extension staging buffer (~5x the pure-Python hot path); falls
-        back silently when the extension can't build."""
+        """`fast_ingest=True` routes per-call histogram samples AND
+        counter increments through C-extension staging buffers (several
+        times the pure-Python hot path); falls back silently when the
+        extension can't build.  Counter amounts beyond 2^31 take the
+        exact-integer Python path so totals never lose precision."""
         if interval <= 0:
             raise ValueError("interval must be positive seconds")
         self.interval = float(interval)
@@ -202,7 +204,11 @@ class MetricSystem:
             if _native.fastpath_available():
                 mod = _native.fastpath_module()
                 self._fastpath = mod
+                # both buffers must exceed the fold threshold (shared
+                # counter _fast_n), or sustained one-sided traffic would
+                # overflow before a fold triggers
                 self._fast_buf = mod.create(1 << 22)
+                self._fast_counter_buf = mod.create(1 << 22)
                 self._fast_record = mod.record
                 self._fast_lock = threading.Lock()
                 self._fast_name_ids: Dict[str, int] = {}
@@ -210,9 +216,11 @@ class MetricSystem:
                 # folded sparse counts, so memory stays O(buckets) like
                 # the Python path regardless of interval length
                 self._fast_folded: Dict[str, Dict[int, int]] = {}
+                self._fast_counter_folded: Dict[str, int] = {}
                 self._fast_n = 0
                 self._fast_fold_threshold = 1 << 21  # half the buffer
                 self._fast_dropped_total = 0  # lifetime-cumulative
+                self._fast_counter_dropped_total = 0
             else:
                 logger.warning(
                     "fast_ingest requested but the extension is "
@@ -259,8 +267,27 @@ class MetricSystem:
             self._thread_local.shard_idx = idx
         return self._shards[idx]
 
+    def _fast_put(self, buf, name: str, value: float) -> None:
+        """Shared fast-path staging: record + fold-threshold heuristic.
+        Folding at half the (equal-sized) buffers' capacity keeps
+        steady-state loss at zero regardless of the counter/histogram
+        traffic mix."""
+        fid = self._fast_name_ids.get(name)
+        if fid is None:
+            fid = self._fast_id(name)
+        self._fast_record(buf, fid, value)
+        self._fast_n += 1
+        if self._fast_n >= self._fast_fold_threshold:
+            self._fast_n = 0
+            self._fast_fold()
+
     def counter(self, name: str, amount: int = 1) -> None:
         """Record `amount` occurrences of an event (metrics.go:251-269)."""
+        # fast path is exact for |amount| <= 2^31 (2^21 records/fold x
+        # 2^31 < 2^53 float64-exact); larger amounts take the int path
+        if self._fast_record is not None and -(1 << 31) <= amount <= 1 << 31:
+            self._fast_put(self._fast_counter_buf, name, float(amount))
+            return
         shard = self._shard()
         with shard.lock:
             shard.counters[name] = shard.counters.get(name, 0) + amount
@@ -285,11 +312,30 @@ class MetricSystem:
             ids_b, vals_b, dropped = self._fastpath.drain(self._fast_buf)
             new_dropped = int(dropped) - self._fast_dropped_total
             self._fast_dropped_total = int(dropped)
+            cids_b, camounts_b, cdropped = self._fastpath.drain(
+                self._fast_counter_buf
+            )
+            new_dropped += int(cdropped) - self._fast_counter_dropped_total
+            self._fast_counter_dropped_total = int(cdropped)
             names = list(self._fast_names)
         if new_dropped > 0:
             logger.error(
                 "fast-ingest buffer overflowed; %d samples shed", new_dropped
             )
+        if cids_b:
+            cids = np.frombuffer(cids_b, dtype=np.int32)
+            camounts = np.frombuffer(camounts_b, dtype=np.float64)
+            sums = np.bincount(cids, weights=camounts)
+            with self._fast_lock:
+                # iterate ids actually recorded (not nonzero sums): a
+                # counter(name, 0) still creates its rate entry, like the
+                # reference
+                for fid in np.unique(cids):
+                    name = names[fid]
+                    self._fast_counter_folded[name] = (
+                        self._fast_counter_folded.get(name, 0)
+                        + int(sums[fid])
+                    )
         if not ids_b:
             return
         fids = np.frombuffer(ids_b, dtype=np.int32)
@@ -313,16 +359,7 @@ class MetricSystem:
         appended raw; log-bucketing happens vectorized (at the buffer cap
         or at collection, whichever comes first)."""
         if self._fast_record is not None:
-            fid = self._fast_name_ids.get(name)
-            if fid is None:
-                fid = self._fast_id(name)
-            self._fast_record(self._fast_buf, fid, value)
-            # racy-but-monotonic heuristic: folding well before the buffer
-            # fills keeps steady-state loss at zero
-            self._fast_n += 1
-            if self._fast_n >= self._fast_fold_threshold:
-                self._fast_n = 0
-                self._fast_fold()
+            self._fast_put(self._fast_buf, name, value)
             return
         shard = self._shard()
         with shard.lock:
@@ -460,11 +497,16 @@ class MetricSystem:
             self._fast_fold()
             with self._fast_lock:
                 fast_folded, self._fast_folded = self._fast_folded, {}
+                fast_counters, self._fast_counter_folded = (
+                    self._fast_counter_folded, {}
+                )
             for name, counts in fast_folded.items():
                 _merge_counts(
                     folded_counts.setdefault(name, {}),
                     counts.keys(), counts.values(),
                 )
+            for name, amount in fast_counters.items():
+                fresh_counters[name] = fresh_counters.get(name, 0) + amount
 
         for shard in self._shards:
             with shard.lock:

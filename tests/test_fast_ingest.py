@@ -33,6 +33,48 @@ def test_fast_ingest_semantic_parity():
         assert out_fast[key] == pytest.approx(v, rel=1e-12), key
 
 
+def test_fast_counter_parity():
+    fast = MetricSystem(interval=1e-6, sys_stats=False, fast_ingest=True)
+    slow = MetricSystem(interval=1e-6, sys_stats=False)
+    for ms in (fast, slow):
+        ms.counter("reqs", 10)
+        ms.counter("reqs", 5)
+        ms.counter("zero", 0)
+    for ms in (fast, slow):
+        m = ms.process_metrics(ms.collect_raw_metrics()).metrics
+        assert m["reqs"] == 15
+        assert m["reqs_rate"] == 15
+        assert m["zero_rate"] == 0  # amount-0 still creates the entry
+    # lifetime accumulates across intervals on the fast path too
+    fast.counter("reqs", 7)
+    m = fast.process_metrics(fast.collect_raw_metrics()).metrics
+    assert m["reqs"] == 22
+    assert m["reqs_rate"] == 7
+
+
+def test_fast_counter_sustained_traffic_no_loss():
+    # the review repro: counter-only traffic beyond the buffer size must
+    # fold, not shed
+    ms = MetricSystem(interval=3600, sys_stats=False, fast_ingest=True)
+    ms._fast_fold_threshold = 1000
+    ms._fast_counter_buf = ms._fastpath.create(2000)
+    n = 50_000
+    for _ in range(n):
+        ms.counter("c", 1)
+    m = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert m["c"] == n
+    assert ms._fast_counter_dropped_total == 0
+
+
+def test_fast_counter_huge_amount_exact():
+    ms = MetricSystem(interval=1e-6, sys_stats=False, fast_ingest=True)
+    huge = (1 << 53) + 1  # not float64-representable
+    ms.counter("big", huge)
+    ms.counter("big", 1)
+    m = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert int(m["big"]) == huge + 1  # exact-int path engaged
+
+
 def test_fast_ingest_concurrent_writers():
     ms = MetricSystem(interval=1e-6, sys_stats=False, fast_ingest=True)
 
