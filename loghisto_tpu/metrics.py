@@ -187,10 +187,13 @@ class MetricSystem:
         fast_ingest: bool = False,
     ):
         """`fast_ingest=True` routes per-call histogram samples AND
-        counter increments through C-extension staging buffers (several
-        times the pure-Python hot path); falls back silently when the
-        extension can't build.  Counter amounts beyond 2^31 take the
-        exact-integer Python path so totals never lose precision."""
+        integer counter increments through C-extension staging buffers
+        (several times the pure-Python hot path); falls back silently
+        when the extension can't build.  The lifetime counter *store*
+        stays integer-exact: amounts beyond 2^31 (and non-integer
+        amounts) take the Python path, and fold sums stay under float64's
+        2^53.  (Exported ProcessedMetricSet values are float64 either
+        way, like the reference's uint64->float64 conversion.)"""
         if interval <= 0:
             raise ValueError("interval must be positive seconds")
         self.interval = float(interval)
@@ -204,11 +207,13 @@ class MetricSystem:
             if _native.fastpath_available():
                 mod = _native.fastpath_module()
                 self._fastpath = mod
-                # both buffers must exceed the fold threshold (shared
+                # every buffer must exceed the fold threshold (shared
                 # counter _fast_n), or sustained one-sided traffic would
-                # overflow before a fold triggers
+                # overflow before a fold triggers; the counter buffer is
+                # created lazily so histogram-only workloads don't pay
+                # for it
                 self._fast_buf = mod.create(1 << 22)
-                self._fast_counter_buf = mod.create(1 << 22)
+                self._fast_counter_buf = None
                 self._fast_record = mod.record
                 self._fast_lock = threading.Lock()
                 self._fast_name_ids: Dict[str, int] = {}
@@ -283,10 +288,23 @@ class MetricSystem:
 
     def counter(self, name: str, amount: int = 1) -> None:
         """Record `amount` occurrences of an event (metrics.go:251-269)."""
-        # fast path is exact for |amount| <= 2^31 (2^21 records/fold x
-        # 2^31 < 2^53 float64-exact); larger amounts take the int path
-        if self._fast_record is not None and -(1 << 31) <= amount <= 1 << 31:
-            self._fast_put(self._fast_counter_buf, name, float(amount))
+        # fast path is exact for INTEGER |amount| <= 2^31 (2^21
+        # records/fold x 2^31 < 2^53 float64-exact); bigger or
+        # non-integer amounts take the Python path unchanged
+        if (
+            self._fast_record is not None
+            and type(amount) is int
+            and -(1 << 31) <= amount <= 1 << 31
+        ):
+            buf = self._fast_counter_buf
+            if buf is None:
+                with self._fast_lock:
+                    if self._fast_counter_buf is None:
+                        self._fast_counter_buf = self._fastpath.create(
+                            1 << 22
+                        )
+                    buf = self._fast_counter_buf
+            self._fast_put(buf, name, amount)
             return
         shard = self._shard()
         with shard.lock:
@@ -312,15 +330,26 @@ class MetricSystem:
             ids_b, vals_b, dropped = self._fastpath.drain(self._fast_buf)
             new_dropped = int(dropped) - self._fast_dropped_total
             self._fast_dropped_total = int(dropped)
-            cids_b, camounts_b, cdropped = self._fastpath.drain(
-                self._fast_counter_buf
-            )
-            new_dropped += int(cdropped) - self._fast_counter_dropped_total
-            self._fast_counter_dropped_total = int(cdropped)
+            if self._fast_counter_buf is not None:
+                cids_b, camounts_b, cdropped = self._fastpath.drain(
+                    self._fast_counter_buf
+                )
+                new_cdropped = (
+                    int(cdropped) - self._fast_counter_dropped_total
+                )
+                self._fast_counter_dropped_total = int(cdropped)
+            else:
+                cids_b, camounts_b, new_cdropped = b"", b"", 0
             names = list(self._fast_names)
         if new_dropped > 0:
             logger.error(
-                "fast-ingest buffer overflowed; %d samples shed", new_dropped
+                "fast-ingest buffer overflowed; %d histogram samples shed",
+                new_dropped,
+            )
+        if new_cdropped > 0:
+            logger.error(
+                "fast-ingest COUNTER buffer overflowed; %d increments shed "
+                "— lifetime totals now under-report", new_cdropped,
             )
         if cids_b:
             cids = np.frombuffer(cids_b, dtype=np.int32)
