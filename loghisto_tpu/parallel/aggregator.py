@@ -499,6 +499,14 @@ class TPUAggregator:
         names = self.registry.names()
         metrics: Dict[str, float] = {}
         with self._agg_lock:
+            if reset:
+                agg_view = self._agg  # interval closes: fold for real
+            else:
+                # peek: report lifetime+current without mutating, so
+                # repeated collect(reset=False) can never double-fold
+                agg_view = {
+                    mid: list(entry) for mid, entry in self._agg.items()
+                }
             for mid, name in enumerate(names):
                 count = int(counts[mid])
                 if count == 0:
@@ -511,13 +519,13 @@ class TPUAggregator:
                     metrics[label % name] = float(value)
                 # int seed: go_compat accumulates exact integers like the
                 # reference's uint64 store; float mode promotes naturally.
-                entry = self._agg.setdefault(mid, [0, 0])
+                entry = agg_view.setdefault(mid, [0, 0])
                 if self.config.go_compat:
                     entry[0] += int(total)
                 else:
                     entry[0] += total
                 entry[1] += count
-            for mid, entry in self._agg.items():
+            for mid, entry in agg_view.items():
                 name = names[mid] if mid < len(names) else None
                 if name is None or entry[1] <= 0:
                     continue

@@ -54,17 +54,30 @@ def print_benchmark(
     interval: float = 1.0,
     out: TextIO = sys.stdout,
     fast_ingest: bool = True,
+    device: bool = False,
 ) -> None:
     """Run `op` at `concurrency` and print statistics each interval.
 
     Blocks for `duration` seconds (forever when None, like the reference).
     Uses the C-extension ingest fast path when available (pass
     fast_ingest=False to benchmark the pure-Python hot path).
+    `device=True` runs the same harness on a TPUMetricSystem, printing
+    statistics computed by the device aggregation path.
     """
-    ms = MetricSystem(
-        interval=interval, sys_stats=True, fast_ingest=fast_ingest
-    )
-    mc = Channel(1)
+    if device:
+        from loghisto_tpu.system import TPUMetricSystem
+
+        ms = TPUMetricSystem(
+            interval=interval, sys_stats=True, fast_ingest=fast_ingest
+        )
+        ms.device_metrics()  # warm the stats compile before ticking starts
+    else:
+        ms = MetricSystem(
+            interval=interval, sys_stats=True, fast_ingest=fast_ingest
+        )
+    # device mode drains slower (a device stats round-trip per interval);
+    # a little slack keeps the reaper from striking the subscriber out
+    mc = Channel(4 if device else 1)
     ms.subscribe_to_processed_metrics(mc)
     ms.start()
     stop = threading.Event()
@@ -81,10 +94,17 @@ def print_benchmark(
                 if stop.is_set():
                     return
                 continue
+            metrics = pms.metrics
+            if device:
+                # statistics extracted by the device aggregation path
+                # (reset=True: per-interval semantics matching host mode),
+                # falling back to host values for counters/gauges
+                metrics = dict(metrics)
+                metrics.update(ms.device_metrics(reset=True).metrics)
             lines = [str(pms.time)]
             for metric in interesting:
                 lines.append(
-                    f"{metric + ':':<{width}}\t{pms.metrics.get(metric, 0)}"
+                    f"{metric + ':':<{width}}\t{metrics.get(metric, 0)}"
                 )
             out.write("\n".join(lines) + "\n\n")
             out.flush()
@@ -135,6 +155,10 @@ def main(argv: Optional[list[str]] = None) -> None:
         "--no-fast", action="store_true",
         help="benchmark the pure-Python hot path",
     )
+    parser.add_argument(
+        "--device", action="store_true",
+        help="aggregate on the device (TPUMetricSystem)",
+    )
     args = parser.parse_args(argv)
 
     def op() -> None:
@@ -143,7 +167,7 @@ def main(argv: Optional[list[str]] = None) -> None:
     print_benchmark(
         args.name, args.concurrency, op,
         duration=args.seconds, interval=args.interval,
-        fast_ingest=not args.no_fast,
+        fast_ingest=not args.no_fast, device=args.device,
     )
 
 

@@ -34,9 +34,11 @@ class TPUMetricSystem(MetricSystem):
         percentiles: Mapping[str, float] = DEFAULT_PERCENTILES,
         mesh=None,
         native_staging: bool = False,
+        fast_ingest: bool = False,
     ):
         super().__init__(
-            interval=interval, sys_stats=sys_stats, config=config
+            interval=interval, sys_stats=sys_stats, config=config,
+            fast_ingest=fast_ingest,
         )
         self.aggregator = TPUAggregator(
             num_metrics=num_metrics,

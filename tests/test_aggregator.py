@@ -45,6 +45,10 @@ def test_collect_without_reset():
     agg.collect(reset=False)
     out = agg.collect(reset=False).metrics
     assert out["m_count"] == 1
+    # peeking must not fold lifetime aggregates (no quadratic growth)
+    assert out["m_agg_count"] == 1
+    final = agg.collect(reset=True).metrics
+    assert final["m_agg_count"] == 1
 
 
 def test_empty_metrics_omitted():

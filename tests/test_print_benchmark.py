@@ -28,6 +28,23 @@ def test_print_benchmark_reports_metrics():
         raise AssertionError("no nonzero count line found:\n" + report)
 
 
+def test_print_benchmark_device_mode():
+    out = io.StringIO()
+    print_benchmark(
+        "dev_op", concurrency=2, op=lambda: None,
+        duration=0.7, interval=0.2, out=out, device=True,
+    )
+    report = out.getvalue()
+    assert "dev_op_count:" in report
+    assert "dev_op_99.9:" in report
+    for line in report.splitlines():
+        if line.startswith("dev_op_count:"):
+            if float(line.split("\t")[-1]) > 0:
+                break
+    else:
+        raise AssertionError("device mode reported no samples:\n" + report)
+
+
 def test_print_benchmark_cli_smoke():
     from loghisto_tpu.print_benchmark import main
 
