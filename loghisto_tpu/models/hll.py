@@ -52,10 +52,13 @@ def _hash32(x: jnp.ndarray) -> jnp.ndarray:
 
 
 @functools.partial(jax.jit, static_argnames=("p",))
-def _insert(registers, values, p):
+def _insert(registers, values, p, n_valid):
     m = 1 << p
     h = _hash32(values)
     idx = (h & jnp.uint32(m - 1)).astype(jnp.int32)
+    # padded entries (index >= n_valid) route to a scrap register
+    valid = jnp.arange(values.shape[0]) < n_valid
+    idx = jnp.where(valid, idx, m)
     rest = h >> p
     # rho: position of the first set bit in the remaining (32-p) bits,
     # counting from 1; all-zero rest gets the maximum 32-p+1.
@@ -65,7 +68,7 @@ def _insert(registers, values, p):
     first = jnp.argmax(set_at, axis=1).astype(jnp.int32)
     any_set = set_at.any(axis=1)
     rho = jnp.where(any_set, first + 1, width + 1)
-    maxes = jax.ops.segment_max(rho, idx, num_segments=m)
+    maxes = jax.ops.segment_max(rho, idx, num_segments=m + 1)[:m]
     maxes = jnp.maximum(maxes, 0)  # segment_max fills empty with -inf/min
     return jnp.maximum(registers, maxes)
 
@@ -73,8 +76,17 @@ def _insert(registers, values, p):
 def insert(
     registers: jnp.ndarray, values, config: HLLConfig = HLLConfig()
 ) -> jnp.ndarray:
-    """Add a batch of values to the sketch."""
-    return _insert(registers, jnp.asarray(values, dtype=jnp.float32), config.p)
+    """Add a batch of values to the sketch.  Batches pad to the next
+    power of two (padding masked out), so arbitrary batch sizes reuse
+    O(log N) compiled executables."""
+    values = jnp.asarray(values, dtype=jnp.float32)
+    n = values.shape[0]
+    padded = 1 << max(0, (int(n) - 1).bit_length())
+    if padded != n:
+        values = jnp.concatenate(
+            [values, jnp.zeros(padded - n, dtype=jnp.float32)]
+        )
+    return _insert(registers, values, config.p, n)
 
 
 def merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

@@ -100,25 +100,37 @@ def _combine(a: MomentsState, b: MomentsState) -> MomentsState:
 
 
 @jax.jit
-def insert(state: MomentsState, values) -> MomentsState:
-    x = jnp.asarray(values, dtype=jnp.float32).reshape(-1)
+def _insert(state: MomentsState, x, n_valid) -> MomentsState:
+    valid = jnp.arange(x.shape[0]) < n_valid
     x = jnp.where(jnp.isnan(x), 0.0, x)  # NaN pinned like bucket_indices
+    x = jnp.where(valid, x, 0.0)
+    nf = jnp.maximum(n_valid.astype(jnp.float32), 1.0)
     new_scale = jnp.maximum(state.scale, jnp.abs(x).max())
     xs = x / new_scale
-    n = x.shape[0]
-    bmean = xs.mean()
-    d = xs - bmean
+    bmean = xs.sum() / nf
+    d = jnp.where(valid, xs - bmean, 0.0)
     batch = MomentsState(
-        count=jnp.int32(n),
+        count=n_valid.astype(jnp.int32),
         mean=bmean,
         m2=(d ** 2).sum(),
         m3=(d ** 3).sum(),
         m4=(d ** 4).sum(),
         scale=new_scale,
-        min=x.min(),
-        max=x.max(),
+        min=jnp.where(valid, x, jnp.inf).min(),
+        max=jnp.where(valid, x, -jnp.inf).max(),
     )
     return _combine(_rescaled(state, new_scale), batch)
+
+
+def insert(state: MomentsState, values) -> MomentsState:
+    """Insert a batch.  Batches pad to the next power of two (padding
+    masked out), so arbitrary batch sizes reuse O(log N) executables."""
+    x = jnp.asarray(values, dtype=jnp.float32).reshape(-1)
+    n = x.shape[0]
+    padded = 1 << max(0, (int(n) - 1).bit_length())
+    if padded != n:
+        x = jnp.concatenate([x, jnp.zeros(padded - n, dtype=jnp.float32)])
+    return _insert(state, x, jnp.int32(n))
 
 
 @jax.jit

@@ -90,16 +90,34 @@ def _insert(means, weights, values, sample_weights, capacity, delta):
     return _compress(all_m[order], all_w[order], capacity, delta)
 
 
+def _pad_pow2(arr: "np_or_jnp", fill: float):
+    """Pad a 1-D array to the next power of two so jit compiles O(log N)
+    executables instead of one per distinct batch length."""
+    import numpy as np
+
+    n = arr.shape[0]
+    padded = 1 << max(0, (int(n) - 1).bit_length())
+    if padded == n:
+        return arr
+    return jnp.concatenate(
+        [jnp.asarray(arr), jnp.full(padded - n, fill, dtype=jnp.float32)]
+    )
+
+
 def insert(
     means, weights, values, sample_weights=None,
     config: TDigestConfig = TDigestConfig(),
 ):
-    """Insert a batch of samples (optionally weighted) into the digest."""
+    """Insert a batch of samples (optionally weighted) into the digest.
+    Batches are padded to the next power of two with weight-0 entries, so
+    arbitrary batch sizes reuse O(log N) compiled executables."""
     values = jnp.asarray(values, dtype=jnp.float32)
     if sample_weights is None:
         sample_weights = jnp.ones_like(values)
     else:
         sample_weights = jnp.asarray(sample_weights, dtype=jnp.float32)
+    values = _pad_pow2(values, 0.0)
+    sample_weights = _pad_pow2(sample_weights, 0.0)  # weight-0: ignored
     return _insert(
         means, weights, values, sample_weights,
         capacity=config.capacity, delta=config.delta,

@@ -415,13 +415,26 @@ class TPUAggregator:
                 weights.append(count)
         if not ids:
             return
+        # pad to a fixed chunk size (dropped id -1): one compiled
+        # executable instead of one per distinct per-interval entry count
+        # (which leaks compile-cache memory interval after interval)
+        chunk = 4096
+        n = len(ids)
+        padded = (n + chunk - 1) // chunk * chunk
+        ids_np = np.full(padded, -1, dtype=np.int32)
+        bidx_np = np.zeros(padded, dtype=np.int32)
+        weights_np = np.zeros(padded, dtype=np.int32)
+        ids_np[:n] = ids
+        bidx_np[:n] = bidx
+        weights_np[:n] = weights
         with self._lock:
-            self._acc = self._weighted_ingest(
-                self._acc,
-                np.asarray(ids, dtype=np.int32),
-                np.asarray(bidx, dtype=np.int32),
-                np.asarray(weights, dtype=np.int32),
-            )
+            for off in range(0, padded, chunk):
+                self._acc = self._weighted_ingest(
+                    self._acc,
+                    ids_np[off:off + chunk],
+                    bidx_np[off:off + chunk],
+                    weights_np[off:off + chunk],
+                )
 
     def attach(self, ms: MetricSystem, channel_capacity: int = 8) -> None:
         """Subscribe to a MetricSystem's raw broadcast; every interval's
