@@ -1,0 +1,113 @@
+"""Direct unit tests for the Channel subscription primitive (previously
+only covered through MetricSystem broadcast tests)."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from loghisto_tpu import Channel, ChannelClosed
+
+
+def test_offer_get_fifo():
+    ch = Channel(4)
+    for i in range(3):
+        assert ch.offer(i)
+    assert [ch.get(), ch.get(), ch.get()] == [0, 1, 2]
+
+
+def test_offer_full_returns_false():
+    ch = Channel(1)
+    assert ch.offer("a")
+    assert not ch.offer("b")
+    assert ch.get() == "a"
+    assert ch.offer("c")
+
+
+def test_get_nonblocking_empty_raises():
+    ch = Channel(1)
+    with pytest.raises(queue.Empty):
+        ch.get(block=False)
+
+
+def test_get_timeout_raises_empty():
+    ch = Channel(1)
+    t0 = time.time()
+    with pytest.raises(queue.Empty):
+        ch.get(timeout=0.05)
+    assert time.time() - t0 >= 0.04
+
+
+def test_close_drains_then_raises():
+    ch = Channel(4)
+    ch.offer(1)
+    ch.offer(2)
+    ch.close()
+    assert ch.get() == 1
+    assert ch.get() == 2
+    with pytest.raises(ChannelClosed):
+        ch.get()
+
+
+def test_close_wakes_blocked_reader():
+    ch = Channel(1)
+    woke = threading.Event()
+
+    def reader():
+        with pytest.raises(ChannelClosed):
+            ch.get(timeout=5)
+        woke.set()
+
+    t = threading.Thread(target=reader)
+    t.start()
+    time.sleep(0.05)
+    ch.close()
+    t.join(timeout=2)
+    assert woke.is_set()
+
+
+def test_offer_after_close_refused():
+    ch = Channel(2)
+    ch.close()
+    assert not ch.offer("x")
+
+
+def test_close_idempotent():
+    ch = Channel(1)
+    ch.close()
+    ch.close()
+    assert ch.closed
+
+
+def test_iteration_ends_on_close():
+    ch = Channel(8)
+    for i in range(3):
+        ch.offer(i)
+    ch.close()
+    assert list(ch) == [0, 1, 2]
+
+
+def test_capacity_validation():
+    with pytest.raises(ValueError):
+        Channel(0)
+
+
+def test_producer_consumer_threaded():
+    ch = Channel(16)
+    received = []
+
+    def consumer():
+        for item in ch:
+            received.append(item)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    sent = 0
+    for i in range(1000):
+        while not ch.offer(i):
+            time.sleep(0.0001)
+        sent += 1
+    ch.close()
+    t.join(timeout=5)
+    assert received == list(range(1000))
