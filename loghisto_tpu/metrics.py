@@ -63,6 +63,8 @@ from loghisto_tpu.utils.sysstats import default_gauges
 
 logger = logging.getLogger("loghisto_tpu")
 
+_UINT64_MASK = 0xFFFFFFFFFFFFFFFF
+
 
 @dataclasses.dataclass
 class RawMetricSet:
@@ -583,12 +585,22 @@ class MetricSystem:
             total_sum, total_count = summarize_sparse(
                 buckets, cnt, self.config.precision
             )
-            sum_inc = int(total_sum) if self.config.go_compat else total_sum
+            # go_compat (metrics.go:374): the float sum converts through
+            # uint64 — truncating fractions, and wrapping negatives mod
+            # 2^64 the way Go's amd64 conversion behaves for the in-range
+            # magnitudes this library sees (Go leaves out-of-range
+            # float->uint conversion implementation-defined, so extreme
+            # >=2^63 sums are not bit-matched across architectures).
+            sum_inc = (
+                int(total_sum) if self.config.go_compat else total_sum
+            )
             agg_increments.append((name, sum_inc, total_count))
         with self._store_lock:
             for name, sum_inc, total_count in agg_increments:
                 entry = self._histogram_agg_store.setdefault(name, [0, 0])
                 entry[0] += sum_inc
+                if self.config.go_compat:
+                    entry[0] &= _UINT64_MASK
                 entry[1] += total_count
 
         with self._gauge_lock:

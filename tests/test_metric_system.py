@@ -174,6 +174,27 @@ def test_agg_quirks_compat_mode():
             assert m["histogram1_agg_sum"] == 331132.0
 
 
+def test_go_compat_uint64_wrap_on_negative_sums():
+    # Reference quirk (metrics.go:374): lifetime sums go through uint64,
+    # so an interval with a negative total WRAPS to a huge value.
+    ms = MetricSystem(
+        interval=1e-6, sys_stats=False, config=MetricConfig(go_compat=True)
+    )
+    ms.histogram("neg", -1000.0)
+    raw = ms.collect_raw_metrics()
+    processed = ms.process_metrics(raw)
+    ms._attach_aggregates(processed, raw)
+    agg_sum = processed.metrics["neg_agg_sum"]
+    assert agg_sum > 1e18  # wrapped, like Go's uint64(-1007.19...)
+    # clean-mode default keeps the true negative sum
+    ms2 = MetricSystem(interval=1e-6, sys_stats=False)
+    ms2.histogram("neg", -1000.0)
+    raw2 = ms2.collect_raw_metrics()
+    p2 = ms2.process_metrics(raw2)
+    ms2._attach_aggregates(p2, raw2)
+    assert p2.metrics["neg_agg_sum"] < 0
+
+
 def test_interval_floor():
     ms = MetricSystem(interval=60.0, sys_stats=False)
     ts = ms._interval_floor(now=123456789.5)
