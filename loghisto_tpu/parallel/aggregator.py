@@ -160,6 +160,7 @@ class TPUAggregator:
         batch_size: int = 1 << 16,
         mesh: Optional[Mesh] = None,
         native_staging: bool = False,
+        ingest_path: str = "scatter",
     ):
         """When `mesh` is given (a ("stream","metric") mesh from
         parallel.mesh.make_mesh), the dense accumulator is laid out
@@ -172,7 +173,16 @@ class TPUAggregator:
         lock-striped buffer (loghisto_tpu._native) instead of Python
         lists — writers release the GIL in the C call, and overflow sheds
         with an exposed drop counter.  Requires the native library; falls
-        back (with a log line) when unavailable."""
+        back (with a log line) when unavailable.
+
+        `ingest_path` selects the device accumulation kernel:
+          * "scatter"  — XLA scatter-add (default; works everywhere)
+          * "matmul"   — one-hot MXU matmul (small metric counts)
+          * "multirow" — metric-tiled Pallas kernel (sorted/block-padded;
+            single-device only, TPU-targeted, interpret-mode elsewhere)
+        All three are bit-identical (tests/test_fast_paths.py,
+        tests/test_pallas_multirow.py); they differ only in speed per
+        configuration — benchmarks/device_paths.py measures them."""
         self.config = config
         self.num_metrics = num_metrics
         # explicit None check: an empty registry is falsy (it has __len__),
@@ -236,7 +246,42 @@ class TPUAggregator:
             self._acc = jnp.zeros(
                 (num_metrics, config.num_buckets), dtype=jnp.int32
             )
-        self._ingest = make_ingest_fn(config.bucket_limit, config.precision)
+        # identity for dense-layout paths; multirow slices its lane padding
+        self._finalize_acc = lambda a: a
+        # per-path zero-accumulator factory (layout differs by path)
+        self._make_acc = self._fresh_dense_acc
+        if ingest_path == "scatter":
+            self._ingest = make_ingest_fn(
+                config.bucket_limit, config.precision
+            )
+        elif ingest_path == "matmul":
+            from loghisto_tpu.ops.matmul_hist import make_matmul_ingest_fn
+
+            self._ingest = make_matmul_ingest_fn(
+                config.bucket_limit, config.precision
+            )
+        elif ingest_path == "multirow":
+            if mesh is not None:
+                raise ValueError(
+                    "ingest_path='multirow' is single-device (its dense "
+                    "layout is lane-padded); use scatter with a mesh"
+                )
+            from loghisto_tpu.ops.pallas_multirow import make_multirow_ingest
+
+            init, multirow_ingest, self._finalize_acc = make_multirow_ingest(
+                num_metrics, config.bucket_limit, config.precision
+            )
+            self._ingest = multirow_ingest
+            # lane-padded accumulator layout; the weighted host-bridge
+            # ingest still works (dense buckets are the leading columns)
+            self._make_acc = init
+            self._acc = init()
+        else:
+            raise ValueError(
+                f"unknown ingest_path {ingest_path!r}: expected 'scatter', "
+                "'matmul', or 'multirow'"
+            )
+        self.ingest_path = ingest_path
         self._weighted_ingest = make_weighted_ingest_fn(config.bucket_limit)
         self._stats_fn = jax.jit(
             functools.partial(
@@ -291,7 +336,7 @@ class TPUAggregator:
         if should_flush:
             self.flush()
 
-    def _fresh_acc(self) -> jnp.ndarray:
+    def _fresh_dense_acc(self) -> jnp.ndarray:
         if self.mesh is not None:
             return make_sharded_accumulator(
                 self.mesh, self.num_metrics, self.config.num_buckets
@@ -299,6 +344,12 @@ class TPUAggregator:
         return jnp.zeros(
             (self.num_metrics, self.config.num_buckets), dtype=jnp.int32
         )
+
+    def _fresh_acc(self) -> jnp.ndarray:
+        """Zero accumulator in THIS ingest path's layout (the multirow
+        path is lane-padded; rebuilding the wrong shape after a device
+        failure would permanently break ingestion)."""
+        return self._make_acc()
 
     def _bound_pending_locked(self) -> None:
         """Enforce max_pending_samples by shedding the OLDEST samples,
@@ -503,7 +554,9 @@ class TPUAggregator:
         from loghisto_tpu.utils.trace import maybe_capture
 
         with maybe_capture("loghisto_collect"):
-            stats = self._stats_fn(acc, np.asarray(ps, dtype=np.float32))
+            stats = self._stats_fn(
+                self._finalize_acc(acc), np.asarray(ps, dtype=np.float32)
+            )
         counts = np.asarray(stats["counts"])
         sums = np.asarray(stats["sums"])
         pcts = np.asarray(stats["percentiles"])
@@ -534,7 +587,10 @@ class TPUAggregator:
                 # reference's uint64 store; float mode promotes naturally.
                 entry = agg_view.setdefault(mid, [0, 0])
                 if self.config.go_compat:
-                    entry[0] += int(total)
+                    # same uint64 semantics as the host tier's store
+                    from loghisto_tpu.metrics import _UINT64_MASK
+
+                    entry[0] = (entry[0] + int(total)) & _UINT64_MASK
                 else:
                     entry[0] += total
                 entry[1] += count

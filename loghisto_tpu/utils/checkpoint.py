@@ -54,11 +54,20 @@ def save(
         payload["ms_agg_counts"] = np.array(
             [v[1] for v in agg.values()], dtype=np.uint64
         )
+        if agg and all(isinstance(v[0], int) for v in agg.values()):
+            # go_compat sums are exact uint64s that float64 would clip
+            # above 2^53; keep the exact form alongside
+            payload["ms_agg_sums_u64"] = np.array(
+                [v[0] & 0xFFFFFFFFFFFFFFFF for v in agg.values()],
+                dtype=np.uint64,
+            )
 
     if aggregator is not None:
         aggregator.flush()
         with aggregator._lock:
-            acc = np.asarray(aggregator._acc)
+            # canonical dense layout: snapshots stay portable across
+            # ingest_path choices (multirow's lane padding is stripped)
+            acc = np.asarray(aggregator._finalize_acc(aggregator._acc))
         with aggregator._agg_lock:
             agg_items = sorted(aggregator._agg.items())
         payload["agg_acc"] = acc
@@ -105,18 +114,25 @@ def restore(
             agg_names = _arr_names(data["ms_agg_names"])
             sums = data["ms_agg_sums"]
             counts = data["ms_agg_counts"]
+            # go_compat stores need INT sums (the uint64 mask would
+            # TypeError on floats); prefer the exact u64 sidecar
+            go_compat = metric_system.config.go_compat
+            if go_compat and "ms_agg_sums_u64" in data:
+                sums = data["ms_agg_sums_u64"]
             with metric_system._store_lock:
                 for name, value in zip(names, values):
                     metric_system._counter_store[name] = int(value)
                 for name, s, c in zip(agg_names, sums, counts):
                     metric_system._histogram_agg_store[name] = [
-                        float(s), int(c)
+                        int(s) if go_compat else float(s), int(c)
                     ]
 
         if aggregator is not None and "agg_acc" in data:
             import jax.numpy as jnp
 
             acc = data["agg_acc"]
+            # snapshots carry the canonical dense layout regardless of the
+            # saving aggregator's ingest_path
             if acc.shape != (
                 aggregator.num_metrics, aggregator.config.num_buckets
             ):
@@ -138,9 +154,20 @@ def restore(
             for saved_id, new_id in row_map:
                 remapped[new_id] += acc[saved_id]
             with aggregator._lock:
+                live_cols = aggregator._acc.shape[1]
+                if live_cols != remapped.shape[1]:
+                    # re-pad the canonical dense rows into the live
+                    # (lane-padded) layout
+                    padded = np.zeros(
+                        (aggregator.num_metrics, live_cols),
+                        dtype=remapped.dtype,
+                    )
+                    padded[:, :remapped.shape[1]] = remapped
+                    remapped = padded
                 aggregator._acc = aggregator._acc + jnp.asarray(remapped)
             id_remap = dict(row_map)
             with aggregator._agg_lock:
+                agg_compat = aggregator.config.go_compat
                 for mid, s, c in zip(
                     data["agg_ids"], data["agg_sums"], data["agg_counts"]
                 ):
@@ -148,7 +175,9 @@ def restore(
                     if new_id is None:
                         continue
                     entry = aggregator._agg.setdefault(new_id, [0, 0])
-                    entry[0] += float(s)
+                    # int sums under go_compat (the uint64 mask applied at
+                    # collect would TypeError on floats)
+                    entry[0] += int(s) if agg_compat else float(s)
                     entry[1] += int(c)
 
 

@@ -104,6 +104,52 @@ def test_registry_full():
         agg.record("c", 1.0)
 
 
+@pytest.mark.parametrize("path", ["scatter", "matmul", "multirow"])
+def test_ingest_paths_agree(path):
+    agg = TPUAggregator(num_metrics=8, config=CFG, ingest_path=path)
+    rng = np.random.default_rng(7)
+    for i in range(8):
+        agg.registry.id_for(f"m{i}")
+    ids = rng.integers(0, 8, 4000).astype(np.int32)
+    values = rng.lognormal(1, 0.7, 4000).astype(np.float32)
+    agg.record_batch(ids, values)
+    out = agg.collect().metrics
+    ref = TPUAggregator(num_metrics=8, config=CFG)
+    for i in range(8):
+        ref.registry.id_for(f"m{i}")
+    ref.record_batch(ids, values)
+    want = ref.collect().metrics
+    assert out.keys() == want.keys()
+    for key in want:
+        assert out[key] == pytest.approx(want[key], rel=1e-6), key
+
+
+def test_ingest_path_validation():
+    import jax
+
+    from loghisto_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError):
+        TPUAggregator(num_metrics=8, config=CFG, ingest_path="warp-drive")
+    with pytest.raises(ValueError):
+        TPUAggregator(
+            num_metrics=8, config=CFG, ingest_path="multirow",
+            mesh=make_mesh(stream=4, metric=2, devices=jax.devices()[:8]),
+        )
+
+
+def test_multirow_path_checkpoint_roundtrip(tmp_path):
+    from loghisto_tpu.utils import checkpoint
+
+    agg = TPUAggregator(num_metrics=8, config=CFG, ingest_path="multirow")
+    agg.record("m", 5.0)
+    path = str(tmp_path / "m.npz")
+    checkpoint.save(path, aggregator=agg)
+    fresh = TPUAggregator(num_metrics=8, config=CFG, ingest_path="multirow")
+    checkpoint.restore(path, aggregator=fresh)
+    assert fresh.collect().metrics["m_count"] == 1
+
+
 def test_mesh_mode_matches_single_device():
     import jax
 

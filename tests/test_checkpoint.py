@@ -80,6 +80,70 @@ def test_restore_rejects_shape_mismatch(tmp_path):
         checkpoint.restore(path, aggregator=other)
 
 
+def test_go_compat_roundtrip_survives_restart(tmp_path):
+    # the review repro: restoring into a go_compat system then recording
+    # must not TypeError on the uint64 mask, and wrapped sums stay exact
+    ms = MetricSystem(
+        interval=1e-6, sys_stats=False, config=MetricConfig(go_compat=True)
+    )
+    ms.histogram("neg", -1000.0)
+    ms.process_metrics(ms.collect_raw_metrics())
+    path = str(tmp_path / "gc.npz")
+    checkpoint.save(path, metric_system=ms)
+
+    fresh = MetricSystem(
+        interval=1e-6, sys_stats=False, config=MetricConfig(go_compat=True)
+    )
+    checkpoint.restore(path, metric_system=fresh)
+    fresh.histogram("neg", -1.0)
+    raw = fresh.collect_raw_metrics()  # must not crash
+    processed = fresh.process_metrics(raw)
+    fresh._attach_aggregates(processed, raw)
+    assert processed.metrics["neg_agg_count"] == 2
+    # the wrapped huge sum round-tripped exactly through the u64 sidecar
+    stored = fresh._histogram_agg_store["neg"][0]
+    assert isinstance(stored, int) and stored > 1 << 60
+
+
+def test_checkpoint_portable_across_ingest_paths(tmp_path):
+    from loghisto_tpu.parallel.aggregator import TPUAggregator
+
+    src = TPUAggregator(num_metrics=8, config=CFG, ingest_path="multirow")
+    src.record("m", 5.0)
+    path = str(tmp_path / "x.npz")
+    checkpoint.save(path, aggregator=src)
+    # restore into a scatter-path aggregator (different acc layout)
+    dst = TPUAggregator(num_metrics=8, config=CFG, ingest_path="scatter")
+    checkpoint.restore(path, aggregator=dst)
+    assert dst.collect().metrics["m_count"] == 1
+
+
+def test_multirow_device_failure_rebuilds_right_layout():
+    from loghisto_tpu.parallel.aggregator import TPUAggregator
+
+    agg = TPUAggregator(num_metrics=8, config=CFG, ingest_path="multirow")
+    agg.retry_cooldown = 0.0
+    agg.registry.id_for("m")
+    real = agg._ingest
+    calls = [0]
+
+    def flaky(acc, ids, values):
+        calls[0] += 1
+        if calls[0] == 1:
+            raise RuntimeError("device gone")
+        return real(acc, ids, values)
+
+    agg._ingest = flaky
+    import numpy as np
+
+    agg.record_batch(
+        np.zeros(10, dtype=np.int32), np.full(10, 5.0, dtype=np.float32)
+    )
+    agg.flush()  # fails; if the acc were deleted it must rebuild PADDED
+    out = agg.collect().metrics
+    assert out["m_count"] == 10
+
+
 def test_atomic_write_leaves_no_tmp(tmp_path):
     ms = MetricSystem(interval=1e-6, sys_stats=False)
     ms.counter("c", 1)
