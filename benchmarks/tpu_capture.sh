@@ -1,0 +1,22 @@
+#!/bin/bash
+# One-shot TPU measurement capture: run everything that needs real
+# hardware and save the results. Use the moment the tunnel is healthy:
+#   bash benchmarks/tpu_capture.sh [outdir]
+set -u
+OUT="${1:-tpu_results_$(date +%Y%m%d_%H%M%S)}"
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== device probe ==" | tee "$OUT/log.txt"
+timeout 300 python -c "import jax; print(jax.devices())" 2>&1 | tail -2 | tee -a "$OUT/log.txt"
+
+echo "== headline bench ==" | tee -a "$OUT/log.txt"
+timeout 900 python bench.py 2>"$OUT/bench.stderr" | tee "$OUT/bench.json" | tee -a "$OUT/log.txt"
+
+echo "== device paths (scatter/matmul/pallas/multirow) ==" | tee -a "$OUT/log.txt"
+timeout 900 python benchmarks/device_paths.py --batch 4194304 --steps 8 2>&1 | tee -a "$OUT/log.txt"
+
+echo "== firehose 10k metrics ==" | tee -a "$OUT/log.txt"
+timeout 600 python -m loghisto_tpu.firehose --metrics 10000 --seconds 10 2>&1 | tail -12 | tee -a "$OUT/log.txt"
+
+echo "== done; results in $OUT ==" | tee -a "$OUT/log.txt"
