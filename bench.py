@@ -30,7 +30,9 @@ NUM_METRICS = 10_000
 BUCKET_LIMIT = 4_096
 BATCH = 1 << 22  # 4.2M samples per step
 STEPS = 16
-STATS_EVERY = 8  # one stats extraction per 8 ingest steps ("interval")
+# One full statistics extraction per simulated interval; 16 batches
+# (~67M samples) per interval approximates a 1s interval at TPU rates.
+STATS_EVERY = 16
 
 
 def zipf_ids(rng: np.random.Generator, n: int, m: int) -> np.ndarray:

@@ -106,30 +106,55 @@ def dense_stats(
     reps = bucket_representatives(bucket_limit, precision)
     sums = acc_f @ reps  # matvec on the MXU
     # Integer cumsum stays exact for any per-interval count the int32
-    # accumulator can hold; only the final division is float32.
+    # accumulator can hold; only threshold derivation is float32.
     cdf = jnp.cumsum(acc.astype(jnp.int32), axis=1)
     counts = cdf[:, -1]
-    # Normalize by the cumsum's own last column: cdfn[-1] == 1.0 exactly
-    # (x/x in IEEE), so p=1.0 always lands inside the populated range.
-    total = jnp.maximum(counts, 1)[:, None].astype(jnp.float32)
-    cdfn = cdf.astype(jnp.float32) / total
 
     ps = jnp.asarray(ps, dtype=jnp.float32)
 
-    # Exact populated-bucket endpoints, immune to division rounding:
-    # min = first bucket with count > 0, max = last bucket with count > 0.
-    populated = acc > 0
-    idx_min = jnp.argmax(populated, axis=1)
-    idx_max = (num_buckets - 1) - jnp.argmax(populated[:, ::-1], axis=1)
+    # Selection rule: first bucket with f32(cdf)/f32(total) >= p.  Instead
+    # of materializing the [M, B] float CDF (a full extra array + division
+    # per cell), derive the integer rank threshold k*[m, p] = the smallest
+    # integer count satisfying the float division — an [M, P] computation —
+    # and search the integer cumsum directly.  Monotonicity of k/total in
+    # k makes the two formulations select identical buckets.
+    # Exact below 2^24 (float32 integers are exact there, and the +/-1
+    # window always brackets the crossover).  Above 2^24 float32 ulp
+    # exceeds 1, so the window may contain no passing candidate; fall
+    # back to k0 itself — within a few ulp of the true rank, i.e. a
+    # relative rank error < 2^-22, far inside the within-one-bucket
+    # contract.  Never use an out-of-int32 sentinel: its cast is
+    # backend-defined.
+    total_f = jnp.maximum(counts, 1).astype(jnp.float32)[:, None]  # [M,1]
+    k0 = jnp.ceil(ps[None, :] * total_f)  # [M, P] first candidate
+    cands = k0[:, :, None] + jnp.arange(-1.0, 2.0)  # [M, P, 3]
+    ok = (cands / total_f[:, :, None] >= ps[None, :, None]) & (cands >= 1.0)
+    best = jnp.min(jnp.where(ok, cands, jnp.inf), axis=2)
+    k_star_f = jnp.where(jnp.isfinite(best), best, k0)
+    # int32-representable float clamp BEFORE the cast (f32(2^31) itself
+    # casts implementation-defined), then the exact integer clamp
+    k_star_f = jnp.clip(k_star_f, 1.0, jnp.float32(2**31 - 256))
+    k_star = jnp.minimum(
+        k_star_f.astype(jnp.int32), jnp.maximum(counts, 1)[:, None]
+    )
 
-    # 0 < p < 1: first bucket where cdf/total >= p (empty prefix buckets
-    # have cdf 0 < p, so the hit always lands on a populated bucket).
-    # Two equivalent formulations of "first index with cdfn >= p":
-    #   * TPU: an argmax reduction over a comparison — VPU-tiled vector
-    #     work, one [M, B] pass per percentile (P is small and static);
-    #     per-row binary search lowers poorly there.
-    #   * CPU/GPU: vmapped searchsorted (binary search), ~3x cheaper than
-    #     the full comparison passes.
+    # Exact populated-bucket endpoints, immune to rounding:
+    # min = first bucket with count > 0 (== first with cdf >= 1),
+    # max = last bucket with count > 0 (max populated index; computed in
+    # one pass with no array reversal).
+    populated = acc > 0
+    iota = jnp.arange(num_buckets, dtype=jnp.int32)[None, :]
+    idx_min = jnp.argmax(populated, axis=1)
+    idx_max = jnp.max(jnp.where(populated, iota, -1), axis=1)
+    idx_max = jnp.maximum(idx_max, 0)  # empty rows: masked out later
+
+    # 0 < p < 1: first bucket whose integer cumsum reaches k* (empty
+    # prefix buckets have cdf 0 < k*, so the hit lands on a populated
+    # bucket).  Two equivalent search formulations:
+    #   * TPU: an argmax reduction over an integer comparison — VPU-tiled
+    #     vector work, one [M, B] pass per percentile (P is small and
+    #     static); per-row binary search lowers poorly there.
+    #   * CPU/GPU: vmapped searchsorted (binary search on the int cumsum).
     # p == 0 / p == 1: the reference iterates only *populated* buckets, so
     # these mean first/last populated bucket — selected exactly.
     on_tpu = jax.devices()[0].platform == "tpu"
@@ -137,18 +162,18 @@ def dense_stats(
         cols = []
         for k in range(ps.shape[0]):
             p = ps[k]
-            pos = jnp.argmax(cdfn >= p, axis=1)
+            pos = jnp.argmax(cdf >= k_star[:, k:k + 1], axis=1)
             cols.append(
                 jnp.where(p <= 0, idx_min, jnp.where(p >= 1, idx_max, pos))
             )
         idx = jnp.stack(cols, axis=1)
     else:
-        def row_search(cdfn_row, lo, hi):
-            pos = jnp.searchsorted(cdfn_row, ps, side="left")
+        def row_search(cdf_row, ks_row, lo, hi):
+            pos = jnp.searchsorted(cdf_row, ks_row, side="left")
             pos = jnp.minimum(pos, num_buckets - 1)
             return jnp.where(ps <= 0, lo, jnp.where(ps >= 1, hi, pos))
 
-        idx = jax.vmap(row_search)(cdfn, idx_min, idx_max)
+        idx = jax.vmap(row_search)(cdf, k_star, idx_min, idx_max)
     pct = reps[idx]
     nonempty = (counts > 0)[:, None]
     return {

@@ -148,6 +148,33 @@ def test_dense_stats_exact_max_with_huge_counts(cfg):
     assert float(out["counts"][0]) == (1 << 26) + 2
 
 
+def test_dense_stats_huge_counts_beyond_float32(cfg):
+    # totals above 2^24: float32 rank derivation may be a few ulp off but
+    # must stay within the bucket-level contract and never collapse to an
+    # endpoint (the review-found sentinel bug)
+    acc = np.zeros((1, cfg.num_buckets), dtype=np.int32)
+    b_lo, b_mid, b_hi = (
+        cfg.bucket_limit + 100, cfg.bucket_limit + 500, cfg.bucket_limit + 900
+    )
+    acc[0, b_lo] = 70_000_000
+    acc[0, b_mid] = 30_000_000
+    acc[0, b_hi] = 348_738  # total 100,348,738 > 2^24
+    ps = np.array([0.5, 0.95, 0.999, 0.9999])
+    out = dense_stats(jnp.asarray(acc), ps, cfg.bucket_limit)
+    got = np.asarray(out["percentiles"][0])
+    reps = {i: float(np.exp((i - cfg.bucket_limit) / 100) - 1)
+            for i in (b_lo, b_mid, b_hi)}
+    def close(x, y):
+        return abs(x / y - 1) < 1e-6
+
+    # true ranks: p50 -> lo, p95 -> mid, p999/p9999 -> mid/hi boundary zone
+    assert close(got[0], reps[b_lo])
+    assert close(got[1], reps[b_mid])
+    assert close(got[2], reps[b_mid]) or close(got[2], reps[b_hi])
+    assert close(got[3], reps[b_hi])
+    assert int(out["counts"][0]) == 100_348_738
+
+
 def test_dense_stats_many_metrics(cfg):
     rng = np.random.default_rng(2)
     m = 16
