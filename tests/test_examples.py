@@ -13,8 +13,16 @@ def test_migrate_from_go_example_runs():
         capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
-    out = proc.stdout
-    for key in ("range_splits", "some_ipc_latency_99.9", "sys.NumGoroutine"):
-        assert key in out
-    # the recorded values actually show up (non-zero)
-    assert "1.0" in out
+    # parse key->value lines; the example prints every key with a 0.0
+    # fallback, so presence alone proves nothing — values must be nonzero
+    values = {}
+    for line in proc.stdout.splitlines():
+        parts = line.split()
+        if len(parts) == 2:
+            try:
+                values[parts[0]] = float(parts[1])
+            except ValueError:
+                pass
+    assert values.get("range_splits") == 1.0
+    assert values.get("some_ipc_latency_99.9", 0.0) > 0
+    assert values.get("sys.NumGoroutine", 0.0) >= 1
