@@ -1,0 +1,148 @@
+"""Raw-interval journal: persist RawMetricSets as JSON lines and replay
+them later.
+
+The reference streams intervals to subscribers and the data is gone; the
+journal is the durable third option next to live broadcast and
+checkpointing: every interval's sparse histograms/counters/rates/gauges
+append to a JSONL file, and `replay()` reconstructs RawMetricSets that
+feed anything the live stream feeds — `MetricSystem.process_metrics`,
+`merge_raw_metric_sets`, or `TPUAggregator.merge_raw` (e.g. re-running
+device aggregation over yesterday's intervals with different
+percentiles).
+
+The format is line-delimited JSON (one interval per line, append-only,
+crash-tolerant: a torn final line is skipped on replay with a warning).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import threading
+from typing import Iterator, Optional
+
+from loghisto_tpu.channel import Channel, ChannelClosed
+from loghisto_tpu.metrics import MetricSystem, RawMetricSet
+
+logger = logging.getLogger("loghisto_tpu")
+
+FORMAT_VERSION = 1
+
+
+class JournalVersionError(Exception):
+    """The journal was written by an incompatible format version — raised
+    from replay() rather than silently skipping every line."""
+
+
+def dump_line(raw: RawMetricSet) -> str:
+    return json.dumps({
+        "v": FORMAT_VERSION,
+        "time": raw.time.timestamp(),
+        "counters": raw.counters,
+        "rates": raw.rates,
+        # JSON keys are strings; bucket indices round-trip via int()
+        "histograms": {
+            name: {str(b): c for b, c in buckets.items()}
+            for name, buckets in raw.histograms.items()
+        },
+        "gauges": raw.gauges,
+    }, separators=(",", ":"))
+
+
+def parse_line(line: str) -> RawMetricSet:
+    obj = json.loads(line)
+    if not isinstance(obj, dict):
+        raise ValueError(f"journal line is not an object: {type(obj)}")
+    if obj.get("v") != FORMAT_VERSION:
+        raise JournalVersionError(
+            f"unsupported journal version {obj.get('v')}"
+        )
+    return RawMetricSet(
+        time=_dt.datetime.fromtimestamp(obj["time"], tz=_dt.timezone.utc),
+        counters={k: int(v) for k, v in obj["counters"].items()},
+        rates={k: int(v) for k, v in obj["rates"].items()},
+        histograms={
+            name: {int(b): int(c) for b, c in buckets.items()}
+            for name, buckets in obj["histograms"].items()
+        },
+        gauges=obj["gauges"],
+    )
+
+
+def replay(path: str) -> Iterator[RawMetricSet]:
+    """Yield every interval in the journal; a torn/corrupt line (crash
+    mid-append) is skipped with a warning.  A format-version mismatch
+    raises JournalVersionError instead — a newer-format journal must not
+    silently replay as empty."""
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield parse_line(line)
+            except JournalVersionError:
+                raise
+            except (json.JSONDecodeError, KeyError, TypeError,
+                    ValueError) as e:
+                logger.warning(
+                    "journal %s line %d unreadable (%s); skipping",
+                    path, lineno, e,
+                )
+
+
+class RawJournal:
+    """A raw-metrics subscriber that appends every interval to a JSONL
+    file.  Subject to the same strike-eviction contract as any
+    subscriber; writing happens on its own thread, never in the reaper."""
+
+    def __init__(
+        self,
+        metric_system: MetricSystem,
+        path: str,
+        channel_capacity: int = 16,
+    ):
+        self.path = path
+        self._ms = metric_system
+        self._capacity = channel_capacity
+        self._ch: Optional[Channel] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        """Open the file and subscribe.  Subscription happens HERE, not in
+        __init__ — a constructed-but-unstarted journal must never sit on
+        the broadcast accruing strikes.  An unopenable path raises to the
+        caller instead of silently killing the writer thread."""
+        if self._thread is not None:
+            return
+        f = open(self.path, "a")
+        self._ch = Channel(self._capacity)
+        self._ms.subscribe_to_raw_metrics(self._ch)
+        self._thread = threading.Thread(
+            target=self._run, args=(f, self._ch), daemon=True,
+            name="loghisto-journal",
+        )
+        self._thread.start()
+
+    def _run(self, f, ch: Channel) -> None:
+        with f:
+            while True:
+                try:
+                    raw = ch.get()
+                except ChannelClosed:
+                    return
+                try:
+                    f.write(dump_line(raw) + "\n")
+                    f.flush()
+                except OSError:
+                    logger.exception("journal write failed; interval lost")
+
+    def stop(self) -> None:
+        if self._ch is not None:
+            self._ms.unsubscribe_from_raw_metrics(self._ch)
+            self._ch.close()
+            self._ch = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

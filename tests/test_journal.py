@@ -1,0 +1,126 @@
+"""Raw-interval journal: dump/replay round-trip, live subscription,
+torn-line tolerance, device replay."""
+
+import time
+
+import pytest
+
+from loghisto_tpu import MetricSystem, MetricConfig, merge_raw_metric_sets
+from loghisto_tpu.parallel.aggregator import TPUAggregator
+from loghisto_tpu.utils import journal
+
+
+def _sample_raw():
+    ms = MetricSystem(interval=1e-6, sys_stats=False)
+    ms.counter("reqs", 42)
+    for v in (33, 59, 330000):
+        ms.histogram("h", v)
+    return ms, ms.collect_raw_metrics()
+
+
+def test_dump_parse_roundtrip():
+    ms, raw = _sample_raw()
+    back = journal.parse_line(journal.dump_line(raw))
+    assert back.counters == raw.counters
+    assert back.rates == raw.rates
+    assert back.histograms == raw.histograms
+    assert back.time == raw.time
+
+
+def test_replay_feeds_processing_and_device(tmp_path):
+    ms, raw = _sample_raw()
+    path = str(tmp_path / "j.jsonl")
+    with open(path, "w") as f:
+        f.write(journal.dump_line(raw) + "\n")
+        f.write(journal.dump_line(raw) + "\n")
+
+    intervals = list(journal.replay(path))
+    assert len(intervals) == 2
+    merged = merge_raw_metric_sets(*intervals)
+    out = ms.process_metrics(merged).metrics
+    assert out["h_count"] == 6
+    single_sum = ms.process_metrics(raw).metrics["h_sum"]
+    assert out["h_sum"] == pytest.approx(2 * single_sum, rel=1e-12)
+
+    agg = TPUAggregator(num_metrics=4, config=MetricConfig())
+    for r in intervals:
+        agg.merge_raw(r)
+    dev = agg.collect().metrics
+    assert dev["h_count"] == 6
+
+
+def test_replay_skips_torn_line(tmp_path, caplog):
+    ms, raw = _sample_raw()
+    path = str(tmp_path / "torn.jsonl")
+    with open(path, "w") as f:
+        f.write(journal.dump_line(raw) + "\n")
+        f.write('{"v":1,"time":123,"counters":{"x"')  # crash mid-append
+    with caplog.at_level("WARNING", logger="loghisto_tpu"):
+        intervals = list(journal.replay(path))
+    assert len(intervals) == 1
+    assert any("unreadable" in r.message for r in caplog.records)
+
+
+def test_replay_skips_non_object_json(tmp_path, caplog):
+    ms, raw = _sample_raw()
+    path = str(tmp_path / "junk.jsonl")
+    with open(path, "w") as f:
+        f.write("null\n42\n")
+        f.write(journal.dump_line(raw) + "\n")
+    with caplog.at_level("WARNING", logger="loghisto_tpu"):
+        intervals = list(journal.replay(path))
+    assert len(intervals) == 1  # junk skipped, valid line survives
+
+
+def test_replay_raises_on_version_mismatch(tmp_path):
+    path = str(tmp_path / "future.jsonl")
+    with open(path, "w") as f:
+        f.write('{"v":2,"time":1,"counters":{},"rates":{},'
+                '"histograms":{},"gauges":{}}\n')
+    with pytest.raises(journal.JournalVersionError):
+        list(journal.replay(path))
+
+
+def test_start_raises_on_bad_path(tmp_path):
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    j = journal.RawJournal(ms, str(tmp_path / "no_dir" / "x.jsonl"))
+    with pytest.raises(OSError):
+        j.start()
+    j.stop()  # safe on a never-started journal
+
+
+def test_unstarted_journal_never_subscribes(tmp_path):
+    # a constructed-but-unstarted journal must not accrue strikes
+    ms = MetricSystem(interval=0.02, sys_stats=False)
+    journal.RawJournal(ms, str(tmp_path / "late.jsonl"))
+    ms.counter("c", 1)
+    ms.start()
+    time.sleep(0.2)  # many broadcasts; no subscriber to evict
+    ms.stop()
+    with ms._subscribers_lock:
+        assert not ms._raw_subscribers
+
+
+def test_live_journal_subscriber(tmp_path):
+    path = str(tmp_path / "live.jsonl")
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    j = journal.RawJournal(ms, path)
+    ms.counter("c", 7)
+    ms.start()
+    j.start()
+    try:
+        deadline = time.time() + 5
+        intervals = []
+        while time.time() < deadline:
+            try:
+                intervals = list(journal.replay(path))
+            except FileNotFoundError:
+                intervals = []
+            if len(intervals) >= 2:
+                break
+            time.sleep(0.05)
+        assert len(intervals) >= 2
+        assert intervals[0].counters["c"] == 7
+    finally:
+        j.stop()
+        ms.stop()
