@@ -1,0 +1,167 @@
+"""Prometheus exposition-format serializer + pull endpoint (layer L4).
+
+The reference ships push-style Graphite/OpenTSDB serializers and notes
+that output plugins are meant to be easy to add (readme.md:113).  This is
+the modern third protocol: the text exposition format served over a pull
+endpoint.
+
+Metric names are sanitized per the Prometheus data model (invalid chars
+become `_`; a leading digit gets a `_` prefix).  Percentile-labelled
+names (`lat_99.9`) are emitted as one `summary`-style family with
+`quantile` labels where recognizable; everything else is a gauge.
+
+    from loghisto_tpu.prometheus import PrometheusEndpoint
+    PrometheusEndpoint(ms, port=9464).start()   # GET /metrics
+"""
+
+from __future__ import annotations
+
+import http.server
+import re
+import threading
+from typing import Optional
+
+from loghisto_tpu.channel import Channel, ChannelClosed
+from loghisto_tpu.metrics import MetricSystem, ProcessedMetricSet
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_QUANTILE_SUFFIX = re.compile(r"^(.*)_(50|75|90|95|99|99\.9|99\.99)$")
+_SUFFIX_TO_Q = {
+    "50": "0.5", "75": "0.75", "90": "0.9", "95": "0.95",
+    "99": "0.99", "99.9": "0.999", "99.99": "0.9999",
+}
+
+
+def _sanitize(name: str) -> str:
+    out = _NAME_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def prometheus_exposition(
+    metric_set: ProcessedMetricSet,
+    include_timestamps: bool = False,
+) -> bytes:
+    """Serialize a ProcessedMetricSet in the text exposition format.
+    Usable directly as a Submitter serializer too (push-gateway style).
+
+    Timestamps are omitted by default: explicitly-timestamped samples
+    bypass Prometheus staleness handling and eventually get rejected as
+    out-of-bounds when re-served from a cache; pass
+    include_timestamps=True only for push-style delivery."""
+    stamp = (
+        f" {int(metric_set.time.timestamp() * 1000)}"
+        if include_timestamps else ""
+    )
+    plain: list[str] = []
+    summaries: dict[str, dict[str, float]] = {}
+    for name, value in sorted(metric_set.metrics.items()):
+        m = _QUANTILE_SUFFIX.match(name)
+        # only treat a _NN suffix as a quantile when its histogram-family
+        # sibling `<base>_count` exists — a counter named `disk_90` must
+        # not masquerade as a latency quantile
+        if m and f"{m.group(1)}_count" in metric_set.metrics:
+            family = _sanitize(m.group(1))
+            q = _SUFFIX_TO_Q[m.group(2)]
+            # keep-first on sanitization collisions: duplicate
+            # family+quantile samples fail the whole scrape
+            summaries.setdefault(family, {}).setdefault(q, value)
+        else:
+            plain.append(f"{_sanitize(name)} {value}{stamp}")
+    lines = []
+    for family, quantiles in sorted(summaries.items()):
+        lines.append(f"# TYPE {family} summary")
+        for q, value in sorted(quantiles.items(), key=lambda x: float(x[0])):
+            lines.append(f'{family}{{quantile="{q}"}} {value}{stamp}')
+    lines.extend(plain)
+    return ("\n".join(lines) + "\n").encode()
+
+
+class PrometheusEndpoint:
+    """Pull endpoint: subscribes to processed metrics, caches the latest
+    interval, and serves it at GET /metrics."""
+
+    def __init__(
+        self,
+        metric_system: MetricSystem,
+        port: int = 9464,
+        host: str = "0.0.0.0",
+    ):
+        self._ms = metric_system
+        self._addr = (host, port)
+        self._ch: Optional[Channel] = None
+        self._latest: bytes = b"# no interval collected yet\n"
+        self._latest_lock = threading.Lock()
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+        self._threads: list[threading.Thread] = []
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1] if self._server else 0
+
+    def start(self) -> None:
+        if self._server is not None:
+            return
+        endpoint = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                import urllib.parse
+
+                path = urllib.parse.urlsplit(self.path).path.rstrip("/")
+                if path not in ("", "/metrics"):
+                    self.send_error(404)
+                    return
+                with endpoint._latest_lock:
+                    payload = endpoint._latest
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        self._server = http.server.ThreadingHTTPServer(self._addr, Handler)
+        self._server.daemon_threads = True
+        self._ch = Channel(8)
+        self._ms.subscribe_to_processed_metrics(self._ch)
+
+        def updater():
+            while True:
+                try:
+                    pms = self._ch.get()
+                except ChannelClosed:
+                    return
+                payload = prometheus_exposition(pms)
+                with self._latest_lock:
+                    self._latest = payload
+
+        self._threads = [
+            threading.Thread(
+                target=self._server.serve_forever, daemon=True,
+                name="loghisto-prom-http",
+            ),
+            threading.Thread(
+                target=updater, daemon=True, name="loghisto-prom-update"
+            ),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._ch is not None:
+            self._ms.unsubscribe_from_processed_metrics(self._ch)
+            self._ch.close()
+            self._ch = None
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []

@@ -66,7 +66,9 @@ def parse_line(line: str) -> RawMetricSet:
             name: {int(b): int(c) for b, c in buckets.items()}
             for name, buckets in obj["histograms"].items()
         },
-        gauges=obj["gauges"],
+        # coerced like the other fields so a corrupt gauges value fails
+        # HERE (inside replay's skip-and-warn net), not at the consumer
+        gauges={k: float(v) for k, v in obj["gauges"].items()},
     )
 
 
@@ -84,8 +86,8 @@ def replay(path: str) -> Iterator[RawMetricSet]:
                 yield parse_line(line)
             except JournalVersionError:
                 raise
-            except (json.JSONDecodeError, KeyError, TypeError,
-                    ValueError) as e:
+            except (json.JSONDecodeError, AttributeError, KeyError,
+                    TypeError, ValueError) as e:
                 logger.warning(
                     "journal %s line %d unreadable (%s); skipping",
                     path, lineno, e,
@@ -116,7 +118,15 @@ class RawJournal:
         caller instead of silently killing the writer thread."""
         if self._thread is not None:
             return
-        f = open(self.path, "a")
+        f = open(self.path, "a+")
+        # a crash mid-append can leave a torn final line with no newline;
+        # terminate it now so the next record starts on its own line
+        # (otherwise BOTH the torn line and the first new record are lost)
+        f.seek(0, 2)
+        if f.tell() > 0:
+            f.seek(f.tell() - 1)
+            if f.read(1) != "\n":
+                f.write("\n")
         self._ch = Channel(self._capacity)
         self._ms.subscribe_to_raw_metrics(self._ch)
         self._thread = threading.Thread(

@@ -124,3 +124,40 @@ def test_live_journal_subscriber(tmp_path):
     finally:
         j.stop()
         ms.stop()
+
+
+def test_restart_after_torn_tail_preserves_new_records(tmp_path):
+    # crash mid-append, then restart: the first post-restart interval
+    # must land on its own line (the torn fragment must not swallow it)
+    ms, raw = _sample_raw()
+    path = str(tmp_path / "restart.jsonl")
+    with open(path, "w") as f:
+        f.write(journal.dump_line(raw) + "\n")
+        f.write('{"v":1,"time":123,"coun')  # torn, no newline
+    ms2 = MetricSystem(interval=0.05, sys_stats=False)
+    j = journal.RawJournal(ms2, path)
+    ms2.counter("after", 5)
+    ms2.start()
+    j.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            intervals = list(journal.replay(path))
+            if len(intervals) >= 2:
+                break
+            time.sleep(0.05)
+        assert len(intervals) >= 2  # original + post-restart records
+        assert intervals[1].counters.get("after") == 5
+    finally:
+        j.stop()
+        ms2.stop()
+
+
+def test_replay_skips_corrupt_gauges(tmp_path, caplog):
+    path = str(tmp_path / "g.jsonl")
+    with open(path, "w") as f:
+        f.write('{"v":1,"time":1,"counters":{},"rates":{},'
+                '"histograms":{},"gauges":null}\n')
+    with caplog.at_level("WARNING", logger="loghisto_tpu"):
+        intervals = list(journal.replay(path))
+    assert intervals == []

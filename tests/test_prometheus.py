@@ -1,0 +1,101 @@
+"""Prometheus exposition serializer + pull endpoint tests."""
+
+import datetime as dt
+import time
+import urllib.request
+
+import pytest
+
+from loghisto_tpu import MetricSystem, ProcessedMetricSet
+from loghisto_tpu.prometheus import (
+    PrometheusEndpoint,
+    prometheus_exposition,
+)
+
+TS = dt.datetime(2026, 1, 2, 3, 4, 5, tzinfo=dt.timezone.utc)
+
+
+def test_exposition_format():
+    pms = ProcessedMetricSet(time=TS, metrics={
+        "lat_50": 10.0,
+        "lat_99.9": 99.0,
+        "lat_count": 5.0,
+        "sys.Alloc": 123.0,
+        "9weird-name": 1.0,
+    })
+    out = prometheus_exposition(pms).decode()
+    assert "# TYPE lat summary" in out
+    assert 'lat{quantile="0.5"} 10.0' in out
+    assert 'lat{quantile="0.999"} 99.0' in out
+    assert "lat_count 5.0" in out
+    assert "sys_Alloc 123.0" in out  # dot sanitized
+    assert "_9weird_name 1.0" in out  # leading digit + dash sanitized
+    # no timestamps by default (staleness handling); opt-in for push
+    ts_ms = int(TS.timestamp() * 1000)
+    assert str(ts_ms) not in out
+    pushed = prometheus_exposition(pms, include_timestamps=True).decode()
+    assert str(ts_ms) in pushed
+
+
+def test_quantile_suffix_requires_family_sibling():
+    # a counter named disk_90 must stay a plain sample, not become a
+    # quantile of a phantom "disk" summary
+    pms = ProcessedMetricSet(time=TS, metrics={"disk_90": 7.0})
+    out = prometheus_exposition(pms).decode()
+    assert "disk_90 7.0" in out
+    assert "quantile" not in out
+
+
+def test_sanitization_collisions_keep_first():
+    pms = ProcessedMetricSet(time=TS, metrics={
+        "a.b_50": 1.0, "a_b_50": 2.0,
+        "a.b_count": 3.0, "a_b_count": 4.0,
+    })
+    out = prometheus_exposition(pms).decode()
+    # exactly one a_b quantile=0.5 sample survives
+    assert out.count('a_b{quantile="0.5"}') == 1
+
+
+def test_endpoint_serves_latest_interval():
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    ep = PrometheusEndpoint(ms, port=0, host="127.0.0.1")
+    ms.counter("reqs", 9)
+    ms.start()
+    ep.start()
+    try:
+        deadline = time.time() + 5
+        body = ""
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{ep.port}/metrics", timeout=2
+            ) as resp:
+                body = resp.read().decode()
+            if "reqs 9.0" in body:
+                break
+            time.sleep(0.05)
+        assert "reqs 9.0" in body
+        assert "reqs_rate" in body
+    finally:
+        ep.stop()
+        ms.stop()
+
+
+def test_endpoint_404_on_other_paths():
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    ep = PrometheusEndpoint(ms, port=0, host="127.0.0.1")
+    ep.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ep.port}/nope", timeout=2
+            )
+    finally:
+        ep.stop()
+
+
+def test_endpoint_stop_idempotent():
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    ep = PrometheusEndpoint(ms, port=0, host="127.0.0.1")
+    ep.start()
+    ep.stop()
+    ep.stop()
