@@ -1,0 +1,116 @@
+"""Everything wired together: host API -> device aggregation -> three
+export paths (Prometheus pull, Graphite push to a demo listener, durable
+journal) -> checkpointed shutdown.  Runs anywhere (CPU backend)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import socketserver
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from loghisto_tpu import TPUMetricSystem
+from loghisto_tpu.graphite import graphite_protocol
+from loghisto_tpu.prometheus import PrometheusEndpoint
+from loghisto_tpu.submitter import new_submitter
+from loghisto_tpu.utils import checkpoint, journal
+
+workdir = tempfile.mkdtemp(prefix="loghisto_demo_")
+
+# a stand-in Graphite/Carbon listener for the push path
+graphite_bytes = [0]
+
+
+class _Carbon(socketserver.StreamRequestHandler):
+    def handle(self):
+        graphite_bytes[0] += len(self.rfile.read())
+
+
+carbon = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _Carbon)
+carbon.daemon_threads = True
+threading.Thread(target=carbon.serve_forever, daemon=True).start()
+
+# one object: host MetricSystem + device aggregator behind the
+# subscription boundary
+ms = TPUMetricSystem(interval=0.3, sys_stats=True, num_metrics=64,
+                     fast_ingest=True)
+prom = PrometheusEndpoint(ms, port=0, host="127.0.0.1")
+logf = journal.RawJournal(ms, os.path.join(workdir, "intervals.jsonl"))
+push = new_submitter(ms, graphite_protocol, "tcp", carbon.server_address)
+
+ms.start()
+prom.start()
+logf.start()
+push.start()
+
+# application load: timers, counters, and a batched firehose
+stop = threading.Event()
+
+
+def worker():
+    while not stop.is_set():
+        with ms.start_timer("request_latency"):
+            pass
+        ms.counter("requests", 1)
+
+
+threads = [threading.Thread(target=worker) for _ in range(2)]
+for t in threads:
+    t.start()
+
+bulk = ms.metric_id("bulk_ingest")
+ms.record_batch(
+    np.full(50_000, bulk, dtype=np.int32),
+    np.random.default_rng(0).lognormal(8, 1, 50_000).astype(np.float32),
+)
+
+# wait (bounded) until at least one interval has been collected, so the
+# demo is deterministic even on a starved machine
+deadline = time.time() + 15
+body = ""
+while time.time() < deadline:
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{prom.port}/metrics", timeout=3
+    ).read().decode()
+    if "requests " in body:
+        break
+    time.sleep(0.1)
+print("== scrape excerpt ==")
+for line in body.splitlines():
+    if line.startswith(("requests ", "# TYPE request_latency")):
+        print(" ", line)
+
+# 2) device-side statistics (percentiles computed on the accelerator)
+dev = ms.device_metrics(reset=False).metrics
+print("== device view ==")
+print(f"  request_latency p99.9 = {dev.get('request_latency_99.9', 0):.0f} ns")
+print(f"  bulk_ingest count     = {dev.get('bulk_ingest_count', 0):.0f}")
+
+stop.set()
+for t in threads:
+    t.join()
+
+# 3) checkpoint lifetime state, stop everything
+snap = os.path.join(workdir, "state.npz")
+checkpoint.save(snap, metric_system=ms, aggregator=ms.aggregator)
+push.shutdown()
+logf.stop()
+prom.stop()
+ms.stop()
+carbon.shutdown()
+print(f"== graphite push: {graphite_bytes[0]} bytes delivered ==")
+
+# 4) the journal replays yesterday's intervals into a fresh system
+intervals = list(journal.replay(os.path.join(workdir, "intervals.jsonl")))
+print(f"== journal: {len(intervals)} intervals captured; "
+      f"checkpoint at {snap} ==")

@@ -7,6 +7,20 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_full_stack_example_runs():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "full_stack.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    assert "scrape excerpt" in out
+    assert "requests " in out
+    assert "bulk_ingest count     = 50000" in out
+    assert "graphite push:" in out
+    assert "journal:" in out and "checkpoint at" in out
+
+
 def test_migrate_from_go_example_runs():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", "migrate_from_go.py")],
