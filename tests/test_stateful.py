@@ -1,0 +1,105 @@
+"""Hypothesis stateful testing: the MetricSystem against a pure-Python
+oracle across arbitrary operation interleavings (record/collect/process
+in any order, both ingest paths)."""
+
+import math
+
+import numpy as np
+from hypothesis import settings
+from hypothesis.stateful import (
+    RuleBasedStateMachine,
+    initialize,
+    invariant,
+    rule,
+)
+from hypothesis import strategies as st
+
+from loghisto_tpu import MetricSystem
+from loghisto_tpu.ops.codec import compress_scalar, decompress_scalar
+
+names = st.sampled_from(["a", "b", "c.d", "e_f"])
+amounts = st.integers(min_value=0, max_value=10**6)
+values = st.floats(
+    min_value=-1e12, max_value=1e12, allow_nan=False, allow_infinity=False
+)
+
+
+class MetricSystemMachine(RuleBasedStateMachine):
+    @initialize(fast=st.booleans())
+    def setup(self, fast):
+        self.ms = MetricSystem(
+            interval=1e-6, sys_stats=False, fast_ingest=fast
+        )
+        # oracle state
+        self.counter_lifetime = {}
+        self.counter_interval = {}
+        self.hist_interval = {}  # name -> list of values
+        self.agg = {}  # name -> [sum, count]
+
+    @rule(name=names, amount=amounts)
+    def counter(self, name, amount):
+        self.ms.counter(name, amount)
+        self.counter_interval[name] = (
+            self.counter_interval.get(name, 0) + amount
+        )
+
+    @rule(name=names, value=values)
+    def histogram(self, name, value):
+        self.ms.histogram(name, value)
+        self.hist_interval.setdefault(name, []).append(value)
+
+    @rule()
+    def collect_and_check(self):
+        raw = self.ms.collect_raw_metrics()
+        processed = self.ms.process_metrics(raw)
+        self.ms._attach_aggregates(processed, raw)
+        m = processed.metrics
+
+        # fold oracle interval state
+        for name, amount in self.counter_interval.items():
+            self.counter_lifetime[name] = (
+                self.counter_lifetime.get(name, 0) + amount
+            )
+
+        # counters: lifetime + rate parity
+        assert raw.counters == self.counter_lifetime
+        assert raw.rates == self.counter_interval
+        for name, total in self.counter_lifetime.items():
+            assert m[name] == float(total)
+
+        # histograms: bucket-exact parity with the scalar codec oracle
+        for name, vals in self.hist_interval.items():
+            expected = {}
+            for v in vals:
+                b = compress_scalar(v)
+                expected[b] = expected.get(b, 0) + 1
+            assert raw.histograms.get(name, {}) == expected, name
+            assert m[f"{name}_count"] == len(vals)
+            exp_sum = sum(
+                decompress_scalar(b) * c for b, c in expected.items()
+            )
+            assert math.isclose(m[f"{name}_sum"], exp_sum, rel_tol=1e-9)
+            entry = self.agg.setdefault(name, [0.0, 0])
+            entry[0] += exp_sum
+            entry[1] += len(vals)
+        # agg only attaches for names present in THIS interval's raw
+        for name in self.hist_interval:
+            s, c = self.agg[name]
+            assert m[f"{name}_agg_count"] == c
+            assert math.isclose(m[f"{name}_agg_sum"], s, rel_tol=1e-9)
+
+        self.counter_interval = {}
+        self.hist_interval = {}
+
+    @invariant()
+    def shards_bounded(self):
+        # ingest-side buffers stay bounded by the fold cap
+        for shard in self.ms._shards:
+            for buf in shard.histograms.values():
+                assert len(buf) <= self.ms.config.ingest_buffer_cap
+
+
+TestMetricSystemMachine = MetricSystemMachine.TestCase
+TestMetricSystemMachine.settings = settings(
+    max_examples=40, stateful_step_count=30, deadline=None
+)
