@@ -92,3 +92,34 @@ def test_histogram_merge_is_order_free(a, b):
     cb = Counter(compress_np(np.array(b)).tolist())
     cab = Counter(compress_np(np.array(a + b)).tolist())
     assert ca + cb == cab
+
+
+@given(
+    st.dictionaries(
+        st.integers(-500, 500), st.integers(1, 100_000),
+        min_size=1, max_size=30,
+    ),
+    st.lists(st.floats(0.0, 1.0), min_size=1, max_size=6),
+)
+@settings(max_examples=60, deadline=None)
+def test_dense_and_sparse_tiers_agree(bucket_counts, ps):
+    """The device tier's dense CDF scan and the host tier's sparse scan
+    must select identical bucket representatives for any histogram."""
+    import jax.numpy as jnp
+
+    from loghisto_tpu.ops.stats import dense_stats
+
+    limit = 512
+    buckets = np.fromiter(bucket_counts.keys(), dtype=np.int64)
+    counts = np.fromiter(bucket_counts.values(), dtype=np.uint64)
+    ps_arr = np.sort(np.array(ps, dtype=np.float64))
+
+    sparse = percentiles_sparse(buckets, counts, ps_arr)
+
+    acc = np.zeros((1, 2 * limit + 1), dtype=np.int32)
+    acc[0, buckets + limit] = counts
+    dense = np.asarray(
+        dense_stats(jnp.asarray(acc), ps_arr, limit)["percentiles"][0]
+    )
+    # float32 representatives vs float64: compare within float32 eps
+    np.testing.assert_allclose(dense, sparse, rtol=1e-5)
