@@ -436,7 +436,22 @@ class MetricSystem:
             self._gauge_funcs.pop(name, None)
 
     def specify_percentiles(self, percentiles: Mapping[str, float]) -> None:
-        """Override the default percentile set (metrics.go:197-201)."""
+        """Override the default percentile set (metrics.go:197-201).
+        Labels are %-format templates applied to the metric name; a
+        malformed template is rejected HERE rather than poisoning every
+        interval's processing later."""
+        for label in percentiles:
+            try:
+                rendered = label % "name"
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    f"percentile label {label!r} is not a valid %-format "
+                    f"template for a metric name: {e}"
+                ) from None
+            if not isinstance(rendered, str):
+                raise ValueError(
+                    f"percentile label {label!r} must render to a string"
+                )
         self._percentiles = dict(percentiles)
 
     # ------------------------------------------------------------------ #

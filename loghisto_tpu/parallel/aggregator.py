@@ -197,6 +197,15 @@ class TPUAggregator:
                 f"num_metrics {num_metrics}: names beyond the accumulator "
                 "rows could never be aggregated"
             )
+        for label in percentiles:
+            try:
+                if not isinstance(label % "name", str):
+                    raise TypeError("renders to non-string")
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    f"percentile label {label!r} is not a valid %-format "
+                    f"template for a metric name: {e}"
+                ) from None
         self.percentiles = dict(percentiles)
         self.batch_size = batch_size
 

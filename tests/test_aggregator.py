@@ -196,3 +196,10 @@ def test_record_batch_shape_mismatch():
     agg = TPUAggregator(num_metrics=2, config=CFG)
     with pytest.raises(ValueError):
         agg.record_batch(np.array([0, 1]), np.array([1.0]))
+
+
+def test_aggregator_rejects_malformed_percentile_labels():
+    with pytest.raises(ValueError):
+        TPUAggregator(
+            num_metrics=4, config=CFG, percentiles={"%d_bad": 0.5}
+        )

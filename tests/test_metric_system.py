@@ -245,3 +245,15 @@ def test_concurrent_ingest():
     metrics = ms.process_metrics(ms.collect_raw_metrics()).metrics
     assert metrics["c"] == 8000
     assert metrics["h_count"] == 8000
+
+
+def test_specify_percentiles_rejects_malformed_labels():
+    ms = MetricSystem(interval=1e-6, sys_stats=False)
+    with pytest.raises(ValueError):
+        ms.specify_percentiles({"%d_bad": 0.5})  # %d of a str
+    with pytest.raises(ValueError):
+        ms.specify_percentiles({"%s_%s": 0.5})  # too many placeholders
+    ms.specify_percentiles({"%s_p50": 0.5})  # valid form accepted
+    ms.histogram("h", 10)
+    out = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert "h_p50" in out
