@@ -1,0 +1,178 @@
+"""Single-process TPU measurement capture.
+
+The axon TPU tunnel has been observed to serve exactly ONE PJRT client
+init per healthy window and then wedge (NOTES_r1.md) — so unlike
+tpu_capture.sh (one python process per stage, one init each), this runs
+EVERY hardware measurement inside one process after one successful init,
+and flushes each stage's results to disk immediately so a mid-run tunnel
+death loses only the in-flight stage.
+
+Usage:  timeout 3900 python benchmarks/tpu_oneshot.py [outdir]
+Exit codes: 0 = captured on TPU, 2 = device init did not reach TPU.
+Driven by benchmarks/tpu_watch.sh in a retry loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import traceback
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def stage(outdir: str, name: str):
+    """Decorator-ish runner: run fn, write its dict result to outdir/name.json,
+    never let one stage's crash kill the rest."""
+
+    def run(fn):
+        log(f"== {name} ==")
+        t0 = time.perf_counter()
+        try:
+            result = fn()
+            result = result if isinstance(result, dict) else {"ok": True}
+            result["stage_seconds"] = round(time.perf_counter() - t0, 1)
+            with open(os.path.join(outdir, f"{name}.json"), "w") as f:
+                json.dump(result, f, indent=1)
+            log(f"== {name} done in {result['stage_seconds']}s ==")
+            return result
+        except BaseException:
+            log(f"== {name} FAILED ==")
+            traceback.print_exc()
+            with open(os.path.join(outdir, f"{name}.error"), "w") as f:
+                traceback.print_exc(file=f)
+            return None
+
+    return run
+
+
+def main() -> int:
+    outdir = sys.argv[1] if len(sys.argv) > 1 else time.strftime(
+        "tpu_results_%Y%m%d_%H%M%S"
+    )
+    os.makedirs(outdir, exist_ok=True)
+
+    log("importing jax + device init (can hang if tunnel is wedged)...")
+    import jax
+
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    platform = devs[0].platform
+    log(f"devices={devs} platform={platform} init={time.perf_counter()-t0:.1f}s")
+    if platform != "tpu":
+        log("not a TPU; nothing to capture here")
+        return 2
+
+    import jax.numpy as jnp
+
+    from loghisto_tpu.config import MetricConfig
+    from loghisto_tpu.ops.ingest import make_ingest_fn
+    from loghisto_tpu.ops.stats import dense_stats
+
+    cfg = MetricConfig(bucket_limit=4096)
+    rng = np.random.default_rng(0)
+
+    # ---- stage 1: headline bench (same workload as bench.py) ----
+    import bench as bench_mod
+
+    def headline():
+        ps = np.array(
+            [0.0, 0.5, 0.75, 0.9, 0.95, 0.99, 0.999, 0.9999, 1.0],
+            dtype=np.float32,
+        )
+        BATCH, STEPS, M = bench_mod.BATCH, bench_mod.STEPS, bench_mod.NUM_METRICS
+        ingest = make_ingest_fn(cfg.bucket_limit, cfg.precision)
+        stats = jax.jit(
+            lambda acc: dense_stats(acc, ps, cfg.bucket_limit, cfg.precision)
+        )
+        ids = jax.device_put(bench_mod.zipf_ids(rng, BATCH, M))
+        values = jax.device_put(
+            rng.lognormal(10.0, 2.0, BATCH).astype(np.float32)
+        )
+        acc = jnp.zeros((M, cfg.num_buckets), dtype=jnp.int32)
+        acc = ingest(acc, ids, values)
+        s = stats(acc)
+        jax.block_until_ready((acc, s))
+        t0 = time.perf_counter()
+        for i in range(STEPS):
+            acc = ingest(acc, ids, values)
+            if (i + 1) % bench_mod.STATS_EVERY == 0:
+                s = stats(acc)
+        jax.block_until_ready((acc, s))
+        dt = time.perf_counter() - t0
+        lat = []
+        for _ in range(20):
+            t1 = time.perf_counter()
+            jax.block_until_ready(stats(acc))
+            lat.append(time.perf_counter() - t1)
+        return {
+            "metric": "histogram samples/sec/chip at 10k metrics",
+            "value": round(BATCH * STEPS / dt, 1),
+            "unit": "samples/s",
+            "vs_baseline": round(
+                BATCH * STEPS / dt / bench_mod.BASELINE_SAMPLES_PER_S, 3
+            ),
+            "percentile_query_p99_us": round(
+                float(np.percentile(lat, 99) * 1e6), 1
+            ),
+            "percentile_query_median_us": round(
+                float(np.median(lat) * 1e6), 1
+            ),
+            "platform": platform,
+            "batch": BATCH,
+            "steps": STEPS,
+            "num_metrics": M,
+            "num_buckets": cfg.num_buckets,
+        }
+
+    stage(outdir, "bench")(headline)
+
+    # ---- stage 2: pallas bit-parity on hardware (VERDICT item 2) ----
+    import benchmarks.pallas_parity as parity_mod
+
+    def parity():
+        rc = parity_mod.main()
+        return {"ok": rc == 0, "exit": rc}
+
+    stage(outdir, "pallas_parity")(parity)
+
+    # ---- stage 3: device ingest paths comparison table ----
+    def paths():
+        import benchmarks.device_paths as dp
+
+        argv, sys.argv = sys.argv, ["device_paths.py", "--batch", str(1 << 22),
+                                    "--steps", "8"]
+        try:
+            dp.main()
+        finally:
+            sys.argv = argv
+        return {"ok": True, "note": "table printed to log"}
+
+    stage(outdir, "device_paths")(paths)
+
+    # ---- stage 4: firehose (device-generated load, 10k metrics) ----
+    def firehose():
+        from loghisto_tpu import firehose as fh
+
+        fh.main(["--metrics", "10000", "--seconds", "10"])
+        return {"ok": True, "note": "output printed to log"}
+
+    stage(outdir, "firehose")(firehose)
+
+    with open(os.path.join(outdir, "SUCCESS"), "w") as f:
+        f.write(time.strftime("%Y-%m-%dT%H:%M:%S\n"))
+    log(f"capture complete; results in {outdir}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

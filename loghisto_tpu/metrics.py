@@ -209,11 +209,9 @@ class MetricSystem:
             if _native.fastpath_available():
                 mod = _native.fastpath_module()
                 self._fastpath = mod
-                # every buffer must exceed the fold threshold (shared
-                # counter _fast_n), or sustained one-sided traffic would
-                # overflow before a fold triggers; the counter buffer is
-                # created lazily so histogram-only workloads don't pay
-                # for it
+                # fold triggers poll size(buf) per-buffer (see _fast_put);
+                # the counter buffer is created lazily so histogram-only
+                # workloads don't pay for it
                 self._fast_buf = mod.create(1 << 22)
                 self._fast_counter_buf = None
                 self._fast_record = mod.record
@@ -224,7 +222,6 @@ class MetricSystem:
                 # the Python path regardless of interval length
                 self._fast_folded: Dict[str, Dict[int, int]] = {}
                 self._fast_counter_folded: Dict[str, int] = {}
-                self._fast_n = 0
                 self._fast_fold_threshold = 1 << 21  # half the buffer
                 self._fast_dropped_total = 0  # lifetime-cumulative
                 self._fast_counter_dropped_total = 0
@@ -278,15 +275,27 @@ class MetricSystem:
         """Shared fast-path staging: record + fold-threshold heuristic.
         Folding at half the (equal-sized) buffers' capacity keeps
         steady-state loss at zero regardless of the counter/histogram
-        traffic mix."""
+        traffic mix.  The fold trigger uses a THREAD-LOCAL stride counter
+        plus the extension's authoritative ``size(buf)`` — a shared Python
+        counter would lose increments under concurrent writers and let the
+        staging buffer overflow before a fold fires.  Worst-case poll lag
+        is 4096 * n_threads records, far inside the half-capacity
+        headroom (2^21 records)."""
         fid = self._fast_name_ids.get(name)
         if fid is None:
             fid = self._fast_id(name)
         self._fast_record(buf, fid, value)
-        self._fast_n += 1
-        if self._fast_n >= self._fast_fold_threshold:
-            self._fast_n = 0
-            self._fast_fold()
+        tl = self._thread_local
+        n = getattr(tl, "fast_n", 0) + 1
+        # stride scales down with the threshold so shrunken test buffers
+        # still poll often enough; capped so the steady-state C-call
+        # overhead stays ~1/4096 records
+        stride = min(4096, self._fast_fold_threshold >> 3) or 1
+        if n >= stride:
+            n = 0
+            if self._fastpath.size(buf) >= self._fast_fold_threshold:
+                self._fast_fold()
+        tl.fast_n = n
 
     def counter(self, name: str, amount: int = 1) -> None:
         """Record `amount` occurrences of an event (metrics.go:251-269)."""

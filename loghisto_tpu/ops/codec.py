@@ -6,9 +6,11 @@ Reference contract (metrics.go:316-332):
     decompress(c) = sign(c) * (e^(|c| / precision) - 1)
 
 With ``precision = 100`` the bucket boundary ratio is e^0.01 ~= 1.0100, so a
-round trip stays within 1% of the true value for |v| >~ 0.51.  Documented
-failure modes (metrics.go:313-315): int16 overflow above ~1e142 and poor
-*relative* precision inside (-0.51, 0.51).  Zero maps to bucket 0 exactly;
+round trip stays within 1% of the true value for |v| >~ 1; below that the
+worst-case relative error grows as ~0.005 * (1 + v) / v (reaching ~1.3% near
+0.51 — the reference's "+/- 0.51" doc comment overstates the zone).
+Documented failure modes (metrics.go:313-315): int16 overflow above ~1e142
+and poor *relative* precision inside (-0.51, 0.51).  Zero maps to bucket 0 exactly;
 negative values get mirrored negative buckets.
 
 Where the reference compresses one scalar per call under a mutex, these are

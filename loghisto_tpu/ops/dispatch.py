@@ -1,0 +1,36 @@
+"""Automatic ingest-path selection (VERDICT r1 item 6).
+
+Three bit-identical device accumulation kernels exist (scatter / one-hot
+MXU matmul / metric-tiled Pallas multirow); they differ only in speed per
+(num_metrics, num_buckets, platform) configuration.  The crossover rule in
+ops/matmul_hist.py ("use when num_metrics*num_buckets <= ~2^21") is made
+real here: ``TPUAggregator(ingest_path="auto")`` — the default — calls
+``choose_ingest_path`` at construction (platform is known then; this is
+NOT a trace-time probe).
+
+Thresholds are provisional pending the real-TPU measurement table from
+benchmarks/device_paths.py (benchmarks/tpu_watch.sh captures it); refresh
+the constants below when BENCH_r02 lands.  On CPU the scatter path wins
+everywhere measured (BENCH_r01 table), so auto == scatter there.
+"""
+
+from __future__ import annotations
+
+# Dense one-hot matmul materializes an [N, B] one-hot per tile; profitable
+# only while the whole [M, B] accumulator is MXU-tile sized.  Above this
+# the scatter path wins (and is the only mesh-shardable formulation).
+MATMUL_MAX_CELLS = 1 << 21
+
+
+def choose_ingest_path(
+    num_metrics: int, num_buckets: int, platform: str
+) -> str:
+    """Pick the measured-fastest ingest kernel for a configuration.
+
+    The Pallas multirow kernel stays opt-in until hardware validation
+    (benchmarks/pallas_parity.py) demotes or promotes it — "auto" never
+    selects an unproven kernel.
+    """
+    if platform == "tpu" and num_metrics * num_buckets <= MATMUL_MAX_CELLS:
+        return "matmul"
+    return "scatter"

@@ -83,6 +83,63 @@ def bucket_representatives(
     return decompress(idx, precision).astype(dtype)
 
 
+def dense_stats_np(
+    acc: np.ndarray,
+    ps: np.ndarray,
+    bucket_limit: int,
+    precision: int = PRECISION,
+) -> dict[str, np.ndarray]:
+    """Host (NumPy, int64) mirror of dense_stats for intervals whose
+    counts exceed what the int32 device accumulator can hold — the
+    overflow-spill path (SURVEY.md §7 hard part (b)).  Exact at any
+    count < 2^53 (float64 integer exactness), same selection rule as
+    percentiles_sparse: first bucket where float(cum)/float(total) >= p.
+    """
+    acc = np.asarray(acc, dtype=np.int64)
+    ps = np.asarray(ps, dtype=np.float64)
+    reps = decompress_np(
+        np.arange(-bucket_limit, bucket_limit + 1, dtype=np.int64), precision
+    )
+    cdf = np.cumsum(acc, axis=1)
+    counts = cdf[:, -1]
+    sums = acc.astype(np.float64) @ reps
+    m, b = acc.shape
+    idx = np.zeros((m, len(ps)), dtype=np.int64)
+    for row in range(m):
+        total = counts[row]
+        if total == 0:
+            continue
+        cdfn = cdf[row].astype(np.float64) / float(total)
+        pos = np.minimum(np.searchsorted(cdfn, ps, side="left"), b - 1)
+        populated = np.nonzero(acc[row])[0]
+        lo, hi = populated[0], populated[-1]
+        idx[row] = np.where(ps <= 0, lo, np.where(ps >= 1, hi, pos))
+    pct = reps[idx]
+    pct[counts == 0] = 0.0
+    return {"counts": counts, "sums": sums, "percentiles": pct}
+
+
+def search_count_below(cdf: jnp.ndarray, k_star: jnp.ndarray) -> jnp.ndarray:
+    """First bucket whose cumsum reaches each rank threshold, computed as
+    the count of buckets still below it — a single fused [M, P, B]
+    compare+sum serving every threshold in one pass over the cumsum (the
+    TPU formulation; equivalent to per-threshold argmax by monotonicity)."""
+    num_buckets = cdf.shape[1]
+    below = (cdf[:, None, :] < k_star[:, :, None]).astype(jnp.int32)
+    return jnp.minimum(jnp.sum(below, axis=2), num_buckets - 1)
+
+
+def search_binary(cdf: jnp.ndarray, k_star: jnp.ndarray) -> jnp.ndarray:
+    """Same selection via vmapped binary search (CPU/GPU formulation)."""
+    num_buckets = cdf.shape[1]
+
+    def row_search(cdf_row, ks_row):
+        pos = jnp.searchsorted(cdf_row, ks_row, side="left")
+        return jnp.minimum(pos, num_buckets - 1)
+
+    return jax.vmap(row_search)(cdf, k_star)
+
+
 def dense_stats(
     acc: jnp.ndarray,
     ps: jnp.ndarray,
@@ -150,30 +207,26 @@ def dense_stats(
 
     # 0 < p < 1: first bucket whose integer cumsum reaches k* (empty
     # prefix buckets have cdf 0 < k*, so the hit lands on a populated
-    # bucket).  Two equivalent search formulations:
-    #   * TPU: an argmax reduction over an integer comparison — VPU-tiled
-    #     vector work, one [M, B] pass per percentile (P is small and
-    #     static); per-row binary search lowers poorly there.
+    # bucket).  Two equivalent search formulations, selected PER LOWERING
+    # PLATFORM (lax.platform_dependent — a trace-time jax.devices() probe
+    # would pick the wrong branch when a CPU-resident accumulator is
+    # processed on a machine that also has a TPU):
+    #   * TPU: position = count of buckets whose cumsum is below the rank
+    #     threshold.  The [M, P, B] compare+sum fuses into ONE pass over
+    #     the cumsum serving all P thresholds at once (metrics.go:408's
+    #     TODO, answered at device scale); per-row binary search lowers
+    #     poorly on TPU.
     #   * CPU/GPU: vmapped searchsorted (binary search on the int cumsum).
     # p == 0 / p == 1: the reference iterates only *populated* buckets, so
     # these mean first/last populated bucket — selected exactly.
-    on_tpu = jax.devices()[0].platform == "tpu"
-    if on_tpu:
-        cols = []
-        for k in range(ps.shape[0]):
-            p = ps[k]
-            pos = jnp.argmax(cdf >= k_star[:, k:k + 1], axis=1)
-            cols.append(
-                jnp.where(p <= 0, idx_min, jnp.where(p >= 1, idx_max, pos))
-            )
-        idx = jnp.stack(cols, axis=1)
-    else:
-        def row_search(cdf_row, ks_row, lo, hi):
-            pos = jnp.searchsorted(cdf_row, ks_row, side="left")
-            pos = jnp.minimum(pos, num_buckets - 1)
-            return jnp.where(ps <= 0, lo, jnp.where(ps >= 1, hi, pos))
-
-        idx = jax.vmap(row_search)(cdf, k_star, idx_min, idx_max)
+    pos = jax.lax.platform_dependent(
+        cdf, k_star, tpu=search_count_below, default=search_binary
+    )
+    idx = jnp.where(
+        ps[None, :] <= 0,
+        idx_min[:, None],
+        jnp.where(ps[None, :] >= 1, idx_max[:, None], pos),
+    )
     pct = reps[idx]
     nonempty = (counts > 0)[:, None]
     return {

@@ -42,9 +42,10 @@ from loghisto_tpu.ops.ingest import (
     make_weighted_ingest_fn,
     sanitize_ids,
 )
-from loghisto_tpu.ops.stats import dense_stats
+from loghisto_tpu.ops.dispatch import choose_ingest_path
+from loghisto_tpu.ops.stats import dense_stats, dense_stats_np
 from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
-from loghisto_tpu.registry import MetricRegistry
+from loghisto_tpu.registry import MetricRegistry, RegistryFullError
 
 
 def local_histogram_fold(
@@ -160,7 +161,10 @@ class TPUAggregator:
         batch_size: int = 1 << 16,
         mesh: Optional[Mesh] = None,
         native_staging: bool = False,
-        ingest_path: str = "scatter",
+        ingest_path: str = "auto",
+        on_registry_full: str = "grow",
+        max_metrics: Optional[int] = None,
+        spill_threshold: int = 1 << 30,
     ):
         """When `mesh` is given (a ("stream","metric") mesh from
         parallel.mesh.make_mesh), the dense accumulator is laid out
@@ -176,13 +180,35 @@ class TPUAggregator:
         back (with a log line) when unavailable.
 
         `ingest_path` selects the device accumulation kernel:
-          * "scatter"  — XLA scatter-add (default; works everywhere)
+          * "auto"     — (default) pick the measured-fastest kernel for
+            (num_metrics, num_buckets, platform) via ops/dispatch.py
+          * "scatter"  — XLA scatter-add (works everywhere)
           * "matmul"   — one-hot MXU matmul (small metric counts)
           * "multirow" — metric-tiled Pallas kernel (sorted/block-padded;
             single-device only, TPU-targeted, interpret-mode elsewhere)
         All three are bit-identical (tests/test_fast_paths.py,
         tests/test_pallas_multirow.py); they differ only in speed per
-        configuration — benchmarks/device_paths.py measures them."""
+        configuration — benchmarks/device_paths.py measures them.
+
+        `on_registry_full` defines the name-cardinality policy when a new
+        name arrives with the registry at capacity (the reference admits
+        new names forever, metrics.go:281-294):
+          * "grow"  — (default) double the accumulator's metric rows (and
+            the registry capacity) up to `max_metrics` (default 8x the
+            initial num_metrics; doubling preserves mesh divisibility).
+            Past max_metrics, samples for unseen names are shed with a
+            counter (`tpu.RegistryShedSamples` gauge) — the library-wide
+            shed-don't-block degradation (SURVEY.md §5.3).
+          * "error" — raise RegistryFullError (round-1 behavior).
+
+        `spill_threshold` bounds int32 accumulator overflow (SURVEY.md §7
+        hard part (b)): once a single interval has ingested this many
+        samples (the worst case concentrates ALL of them in one cell),
+        the device accumulator is folded into a host int64 spill tensor
+        and reset, without closing the interval.  collect() merges the
+        spill back in and computes that interval's statistics in exact
+        int64 on host.  The default (2^30) can never wrap: 2^30 ingested
+        samples + one further flush round cannot reach 2^31 in any cell."""
         self.config = config
         self.num_metrics = num_metrics
         # explicit None check: an empty registry is falsy (it has __len__),
@@ -222,6 +248,37 @@ class TPUAggregator:
         self._shed_samples = 0
         self._device_down_until = 0.0
         self._interval_ingested = 0  # samples in the live accumulator
+
+        if on_registry_full not in ("grow", "error"):
+            raise ValueError(
+                f"on_registry_full={on_registry_full!r}: expected 'grow' "
+                "or 'error'"
+            )
+        self.on_registry_full = on_registry_full
+        self.max_metrics = (
+            int(max_metrics) if max_metrics is not None else num_metrics * 8
+        )
+        if self.max_metrics < num_metrics:
+            raise ValueError(
+                f"max_metrics {self.max_metrics} < num_metrics {num_metrics}"
+            )
+        if not 0 < spill_threshold <= 1 << 30:
+            raise ValueError(
+                "spill_threshold must be in (0, 2^30]: the overflow "
+                "guarantee needs threshold + one ingest chunk < 2^31"
+            )
+        if spill_threshold + batch_size >= 1 << 31:
+            raise ValueError(
+                f"spill_threshold {spill_threshold} + batch_size "
+                f"{batch_size} >= 2^31: a single chunk between spill "
+                "checks could wrap an int32 cell"
+            )
+        self.spill_threshold = int(spill_threshold)
+        # int64 host fold of pre-spill interval counts (canonical dense
+        # layout); engaged only when an interval exceeds spill_threshold
+        self._spill: Optional[np.ndarray] = None
+        self._spilled_samples = 0  # this interval's spilled count
+        self._registry_shed_samples = 0  # lifetime, past-max_metrics names
         if native_staging:
             from loghisto_tpu import _native
 
@@ -254,6 +311,15 @@ class TPUAggregator:
         else:
             self._acc = jnp.zeros(
                 (num_metrics, config.num_buckets), dtype=jnp.int32
+            )
+        if ingest_path == "auto":
+            platform = (
+                mesh.devices.flat[0].platform
+                if mesh is not None
+                else jax.default_backend()
+            )
+            ingest_path = choose_ingest_path(
+                num_metrics, config.num_buckets, platform
             )
         # identity for dense-layout paths; multirow slices its lane padding
         self._finalize_acc = lambda a: a
@@ -310,9 +376,112 @@ class TPUAggregator:
 
     def record(self, name: str, value: float) -> None:
         self.record_batch(
-            np.array([self.registry.id_for(name)], dtype=np.int32),
+            np.array([self._id_for(name)], dtype=np.int32),
             np.array([value], dtype=np.float32),
         )
+
+    def _id_for(self, name: str, samples: int = 1) -> int:
+        """Row id for a name, applying the on_registry_full policy: grow
+        the row space geometrically up to max_metrics, then shed (-1 —
+        every ingest kernel drops it) with a counter.  `samples` is how
+        many samples ride on this lookup (merge_raw passes a histogram's
+        whole interval count), so the shed gauge reports true loss."""
+        try:
+            return self.registry.id_for(name)
+        except RegistryFullError:
+            if self.on_registry_full == "error":
+                raise
+        with self._lock:
+            try:
+                return self.registry.id_for(name)  # a racer may have grown
+            except RegistryFullError:
+                pass
+            if self._grow_locked():
+                return self.registry.id_for(name)
+            first = self._registry_shed_samples == 0
+            self._registry_shed_samples += samples
+            if first:
+                import logging
+
+                logging.getLogger("loghisto_tpu").warning(
+                    "metric registry exhausted at max_metrics=%d; samples "
+                    "for further new names are shed (tpu.RegistryShedSamples"
+                    " counts them)", self.max_metrics,
+                )
+            return -1
+
+    def _grow_row_unit(self) -> int:
+        """Row-count granularity growth must preserve: the mesh metric
+        axis (shard divisibility) or the multirow kernel's row tile."""
+        if self.mesh is not None:
+            return self.mesh.shape[METRIC_AXIS]
+        if self.ingest_path == "multirow":
+            return 8  # make_multirow_ingest's rows_tile default
+        return 1
+
+    def _grow_locked(self, target: Optional[int] = None) -> bool:
+        """Grow the metric-row space in place (caller holds _lock): pad
+        the accumulator (and spill) with zero rows, re-shard in mesh mode,
+        rebuild the shape-specialized multirow kernel.  Returns False when
+        no growth is possible (max_metrics reached, or the divisibility
+        unit leaves no room).  All fallible work happens BEFORE any state
+        is committed, so a failed grow leaves the aggregator untouched.
+        Geometric growth bounds jit recompiles at log2(max/initial)."""
+        old_m = self.num_metrics
+        unit = self._grow_row_unit()
+        new_m = min(
+            target if target is not None else old_m * 2, self.max_metrics
+        )
+        new_m -= new_m % unit  # clamp may land off-grid; round down
+        if new_m <= old_m:
+            return False
+        # -- fallible section: build everything in locals first --
+        make_acc, ingest, finalize = (
+            self._make_acc, self._ingest, self._finalize_acc
+        )
+        if self.ingest_path == "multirow":
+            from loghisto_tpu.ops.pallas_multirow import make_multirow_ingest
+
+            make_acc, ingest, finalize = make_multirow_ingest(
+                new_m, self.config.bucket_limit, self.config.precision
+            )
+        acc_np = np.asarray(self._acc)
+        grown = np.zeros((new_m, acc_np.shape[1]), dtype=acc_np.dtype)
+        grown[:old_m] = acc_np
+        if self.mesh is not None:
+            new_acc = jax.device_put(
+                grown, NamedSharding(self.mesh, P(METRIC_AXIS, None))
+            )
+        else:
+            new_acc = jnp.asarray(grown)
+        # -- commit --
+        self._make_acc, self._ingest, self._finalize_acc = (
+            make_acc, ingest, finalize
+        )
+        self._acc = new_acc
+        self.num_metrics = new_m
+        self.registry.grow(new_m)
+        if self._spill is not None:
+            spill = np.zeros(
+                (new_m, self._spill.shape[1]), dtype=self._spill.dtype
+            )
+            spill[:old_m] = self._spill
+            self._spill = spill
+        return True
+
+    def _spill_fold_locked(self) -> None:
+        """Fold the device accumulator into the host int64 spill tensor and
+        reset it, WITHOUT closing the interval (caller holds _lock).  Keeps
+        every per-cell device count below spill_threshold + one flush
+        round — the int32 overflow guarantee."""
+        acc_np = np.asarray(self._finalize_acc(self._acc), dtype=np.int64)
+        if self._spill is None:
+            self._spill = acc_np
+        else:
+            self._spill += acc_np
+        self._acc = self._fresh_acc()
+        self._spilled_samples += self._interval_ingested
+        self._interval_ingested = 0
 
     def record_batch(self, ids: np.ndarray, values: np.ndarray) -> None:
         """Buffer a batch of (metric_id, value) samples; flushes to device
@@ -428,6 +597,14 @@ class TPUAggregator:
                     )
                     self._device_down_until = 0.0
                     self._interval_ingested += min(bs, n - off)
+                    # int32 overflow guarantee: the check must run per
+                    # chunk — a force-flush of a large host backlog could
+                    # otherwise push a hot cell past 2^31 before any
+                    # post-loop check (worst case all samples hit one
+                    # cell; threshold + batch_size < 2^31 is validated
+                    # at construction)
+                    if self._interval_ingested >= self.spill_threshold:
+                        self._spill_fold_locked()
                 except Exception:
                     import logging
 
@@ -465,36 +642,68 @@ class TPUAggregator:
 
     def merge_raw(self, raw: RawMetricSet) -> None:
         """Merge one host-tier interval (sparse bucket maps) into the dense
-        device accumulator via a weighted scatter-add."""
+        device accumulator via ONE weighted scatter-add launch.
+
+        Padding goes to the next power of two (dropped id -1), so the
+        compile cache holds at most log2(max entries) executables while a
+        10k-metric interval still costs a single launch — the round-1
+        fixed-4096-chunk loop serialized ~hundreds of launches under the
+        ingest lock, stalling record_batch flushes (VERDICT r1 item 9).
+
+        Counts too large for the int32 device path (or intervals that
+        would push a cell past the spill threshold) are folded directly
+        into the int64 host spill instead — exact at any magnitude."""
         ids, bidx, weights = [], [], []
         for name, bucket_counts in raw.histograms.items():
-            mid = self.registry.id_for(name)
+            mid = self._id_for(name, samples=sum(bucket_counts.values()))
+            if mid < 0:
+                continue  # shed (already counted, with its true weight)
             for bucket, count in bucket_counts.items():
                 ids.append(mid)
-                bidx.append(bucket)  # codec bucket; kernel clips to range
+                bidx.append(bucket)  # codec bucket; clipped to range below
                 weights.append(count)
         if not ids:
             return
-        # pad to a fixed chunk size (dropped id -1): one compiled
-        # executable instead of one per distinct per-interval entry count
-        # (which leaks compile-cache memory interval after interval)
-        chunk = 4096
         n = len(ids)
-        padded = (n + chunk - 1) // chunk * chunk
-        ids_np = np.full(padded, -1, dtype=np.int32)
-        bidx_np = np.zeros(padded, dtype=np.int32)
-        weights_np = np.zeros(padded, dtype=np.int32)
-        ids_np[:n] = ids
-        bidx_np[:n] = bidx
-        weights_np[:n] = weights
+        ids_np = np.asarray(ids, dtype=np.int32)
+        bidx_np = np.asarray(bidx, dtype=np.int64)
+        weights_np = np.asarray(weights, dtype=np.int64)
+        total = int(weights_np.sum())
         with self._lock:
-            for off in range(0, padded, chunk):
-                self._acc = self._weighted_ingest(
-                    self._acc,
-                    ids_np[off:off + chunk],
-                    bidx_np[off:off + chunk],
-                    weights_np[off:off + chunk],
+            if (
+                self._interval_ingested + total >= self.spill_threshold
+                or (n and int(weights_np.max()) >= 1 << 30)
+            ):
+                # giant merge: keep the int32 guarantee by applying it on
+                # the host spill in exact int64
+                self._spill_fold_locked()
+                keep = (ids_np >= 0) & (ids_np < self.num_metrics)
+                dense_idx = (
+                    np.clip(
+                        bidx_np[keep],
+                        -self.config.bucket_limit,
+                        self.config.bucket_limit,
+                    )
+                    + self.config.bucket_limit
                 )
+                np.add.at(
+                    self._spill,
+                    (ids_np[keep].astype(np.int64), dense_idx),
+                    weights_np[keep],
+                )
+                self._spilled_samples += int(weights_np[keep].sum())
+                return
+            padded = max(4096, 1 << (n - 1).bit_length())
+            ids_pad = np.full(padded, -1, dtype=np.int32)
+            bidx_pad = np.zeros(padded, dtype=np.int32)
+            weights_pad = np.zeros(padded, dtype=np.int32)
+            ids_pad[:n] = ids_np
+            bidx_pad[:n] = bidx_np
+            weights_pad[:n] = weights_np
+            self._acc = self._weighted_ingest(
+                self._acc, ids_pad, bidx_pad, weights_pad
+            )
+            self._interval_ingested += total
 
     def attach(self, ms: MetricSystem, channel_capacity: int = 8) -> None:
         """Subscribe to a MetricSystem's raw broadcast; every interval's
@@ -554,24 +763,44 @@ class TPUAggregator:
         # the very buffer stats are reading.)
         with self._lock:
             acc = self._acc
+            spill = self._spill
             if reset:
                 # zeros_like preserves the NamedSharding in mesh mode
                 self._acc = jnp.zeros_like(acc)
                 self._interval_ingested = 0
+                self._spill = None
+                self._spilled_samples = 0
             else:
                 acc = acc + 0  # defensive copy; donation-safe snapshot
+                spill = None if spill is None else spill.copy()
         from loghisto_tpu.utils.trace import maybe_capture
 
-        with maybe_capture("loghisto_collect"):
-            stats = self._stats_fn(
-                self._finalize_acc(acc), np.asarray(ps, dtype=np.float32)
+        if spill is not None:
+            # overflow-spill interval: counts exceed int32 on device, so
+            # the whole extraction runs in exact int64 on host
+            combined = spill + np.asarray(
+                self._finalize_acc(acc), dtype=np.int64
             )
+            stats = dense_stats_np(
+                combined,
+                np.asarray(ps, dtype=np.float64),
+                self.config.bucket_limit,
+                self.config.precision,
+            )
+        else:
+            with maybe_capture("loghisto_collect"):
+                stats = self._stats_fn(
+                    self._finalize_acc(acc), np.asarray(ps, dtype=np.float32)
+                )
         counts = np.asarray(stats["counts"])
         sums = np.asarray(stats["sums"])
         pcts = np.asarray(stats["percentiles"])
         self._last_aggregation_us = (time.perf_counter() - t0) * 1e6
 
         names = self.registry.names()
+        # a concurrent grow() may have registered names beyond the rows of
+        # this snapshot; they belong to the next interval
+        names = names[: len(counts)]
         metrics: Dict[str, float] = {}
         with self._agg_lock:
             if reset:
@@ -645,4 +874,11 @@ class TPUAggregator:
             )
         ms.register_gauge_func(
             "tpu.SamplesShed", lambda: float(self._shed_samples)
+        )
+        ms.register_gauge_func(
+            "tpu.RegistryShedSamples",
+            lambda: float(self._registry_shed_samples),
+        )
+        ms.register_gauge_func(
+            "tpu.SpilledSamples", lambda: float(self._spilled_samples)
         )

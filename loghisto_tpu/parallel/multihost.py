@@ -57,35 +57,81 @@ def global_mesh(metric: int = 1):
 
 def local_sample_shard(global_batch: int) -> tuple[int, int]:
     """(start, size) of this host's slice of a `global_batch`-sized sample
-    axis, proportional to its local device count."""
-    total = jax.device_count()
-    local = jax.local_device_count()
+    axis, proportional to its local device count.
+
+    Positions come from this process's devices' indices in the
+    ``jax.devices()`` GLOBAL ORDER — the same order make_mesh lays the
+    mesh out in — never from ``device.id``: device ids are not dense
+    across processes (virtual CPU devices in process 1 are numbered
+    2048+), and an id-based offset silently produced an out-of-range,
+    empty sample slice for every process but 0 (caught by the 2-process
+    test, tests/multihost_worker.py)."""
+    devs = jax.devices()
+    total = len(devs)
     if global_batch % total:
         raise ValueError(
             f"global_batch={global_batch} not divisible by device count "
             f"{total}"
         )
     per_device = global_batch // total
-    # Validate the contiguity assumption instead of silently overlapping:
-    # this mapping requires local device ids to form a dense range.
-    local_ids = sorted(d.id for d in jax.local_devices())
-    if local_ids != list(range(local_ids[0], local_ids[0] + local)):
+    me = jax.process_index()
+    positions = [i for i, d in enumerate(devs) if d.process_index == me]
+    # Validate contiguity instead of silently overlapping: the mesh's
+    # stream axis maps contiguous device positions to contiguous sample
+    # slices, so a process's devices must form a dense position range.
+    if positions != list(range(positions[0], positions[0] + len(positions))):
         raise RuntimeError(
-            f"local device ids {local_ids} are not contiguous; derive the "
-            "shard from a prefix sum of per-process device counts instead"
+            f"process {me}'s device positions {positions} are not "
+            "contiguous in jax.devices() order; derive the shard from a "
+            "prefix sum of per-process device counts instead"
         )
-    return local_ids[0] * per_device, local * per_device
+    return positions[0] * per_device, len(positions) * per_device
 
 
 def make_global_arrays(mesh, ids_local, values_local):
-    """Assemble global sample arrays from per-host local shards using
-    jax.make_array_from_process_local_data — each host supplies only its
-    own samples; no host materializes the global batch."""
+    """Assemble global sample arrays from per-host local shards — each
+    host supplies only its own samples; no host materializes the global
+    batch.
+
+    Built on jax.make_array_from_callback keyed by each addressable
+    device's GLOBAL stream slice.  (make_array_from_process_local_data
+    is wrong here: with a metric axis > 1 the sample arrays are sharded
+    over stream but REPLICATED over metric, and that API divides the
+    process-local buffer across all local devices — every metric shard
+    would silently see only 1/metric of the stream.  Caught by the
+    2-process test, tests/multihost_worker.py.)"""
+    import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from loghisto_tpu.parallel.mesh import STREAM_AXIS
 
+    ids_local = np.asarray(ids_local)
+    values_local = np.asarray(values_local)
     sharding = NamedSharding(mesh, P(STREAM_AXIS))
-    ids = jax.make_array_from_process_local_data(sharding, ids_local)
-    values = jax.make_array_from_process_local_data(sharding, values_local)
-    return ids, values
+    n_local = ids_local.shape[0]
+    global_n = n_local * jax.process_count()
+    start, size = local_sample_shard(global_n)
+    if size != n_local:
+        raise ValueError(
+            f"local shard has {n_local} samples but this process's share "
+            f"of the global batch is {size} (equal per-process shards "
+            "required)"
+        )
+
+    def build(local):
+        def cb(index):
+            sl = index[0]
+            lo = 0 if sl.start is None else sl.start
+            hi = global_n if sl.stop is None else sl.stop
+            if lo < start or hi > start + size:
+                raise RuntimeError(
+                    f"addressable shard [{lo}:{hi}) falls outside this "
+                    f"process's sample range [{start}:{start + size})"
+                )
+            return local[lo - start:hi - start]
+
+        return jax.make_array_from_callback(
+            (global_n,), sharding, cb
+        )
+
+    return build(ids_local), build(values_local)

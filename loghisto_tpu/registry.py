@@ -44,6 +44,15 @@ class MetricRegistry:
             self._name_to_id[name] = new_id
             return new_id
 
+    def grow(self, new_capacity: int) -> None:
+        """Raise capacity (never shrinks; ids are stable).  Used by the
+        aggregator's on_registry_full="grow" policy — the reference admits
+        new names forever (metrics.go:281-294), so the device tier grows
+        its row space geometrically instead of hard-failing."""
+        with self._lock:
+            if new_capacity > self.capacity:
+                self.capacity = new_capacity
+
     def lookup(self, name: str) -> Optional[int]:
         return self._name_to_id.get(name)
 

@@ -132,22 +132,61 @@ def restore(
 
             acc = data["agg_acc"]
             # snapshots carry the canonical dense layout regardless of the
-            # saving aggregator's ingest_path
-            if acc.shape != (
-                aggregator.num_metrics, aggregator.config.num_buckets
+            # saving aggregator's ingest_path; the target may have MORE
+            # rows than the snapshot (on_registry_full="grow")
+            if (
+                acc.shape[1] != aggregator.config.num_buckets
+                or acc.shape[0] > aggregator.num_metrics
             ):
                 raise ValueError(
                     f"checkpoint accumulator shape {acc.shape} does not "
-                    "match the aggregator's configuration"
+                    "fit the aggregator's configuration "
+                    f"({aggregator.num_metrics}, "
+                    f"{aggregator.config.num_buckets})"
                 )
             # Remap by NAME, not by row id: the target registry may already
             # hold other names at the checkpoint's ids.  Saved rows are
             # added into the target's rows for their re-registered ids.
+            # Registration goes through the aggregator's _id_for so the
+            # on_registry_full="grow" policy applies to restores exactly as
+            # it does to live ingestion; a shed name (-1, past max_metrics)
+            # drops that row with a warning rather than aborting mid-way.
             saved_names = _arr_names(data["agg_names"])
-            row_map = [
-                (saved_id, aggregator.registry.id_for(name))
-                for saved_id, name in enumerate(saved_names)
-            ]
+            row_map = []
+            for saved_id, name in enumerate(saved_names):
+                new_id = aggregator._id_for(name)
+                if new_id < 0:
+                    import logging
+
+                    logging.getLogger("loghisto_tpu").warning(
+                        "restore: metric %r shed (registry at max_metrics)",
+                        name,
+                    )
+                    continue
+                row_map.append((saved_id, new_id))
+            # Rows populated via record_batch with raw ids that were never
+            # registered carry no name; map them identity (same row id) so
+            # their counts survive the round trip — but ONLY when that row
+            # is not claimed by a named metric (in the target registry or
+            # by the named remap above): merging an unnamed row into a
+            # named metric would silently corrupt its histogram.
+            named_rows = {saved_id for saved_id, _ in row_map}
+            named_targets = {new_id for _, new_id in row_map}
+            target_named_rows = len(aggregator.registry)
+            for saved_id in range(acc.shape[0]):
+                if saved_id in named_rows or not acc[saved_id].any():
+                    continue
+                if saved_id in named_targets or saved_id < target_named_rows:
+                    import logging
+
+                    logging.getLogger("loghisto_tpu").warning(
+                        "restore: dropping unnamed checkpoint row %d — its "
+                        "row id is owned by a named metric in the target; "
+                        "register names before saving to keep such rows",
+                        saved_id,
+                    )
+                    continue
+                row_map.append((saved_id, saved_id))
             remapped = np.zeros(
                 (aggregator.num_metrics, acc.shape[1]), dtype=acc.dtype
             )

@@ -97,11 +97,21 @@ def test_device_gauges_registered():
 def test_registry_full():
     from loghisto_tpu.registry import RegistryFullError
 
+    # default policy grows past capacity (the reference admits new names
+    # forever, metrics.go:281-294); "error" restores the hard-fail
     agg = TPUAggregator(num_metrics=2, config=CFG)
     agg.record("a", 1.0)
     agg.record("b", 1.0)
+    agg.record("c", 1.0)
+    assert agg.num_metrics == 4
+
+    strict = TPUAggregator(
+        num_metrics=2, config=CFG, on_registry_full="error"
+    )
+    strict.record("a", 1.0)
+    strict.record("b", 1.0)
     with pytest.raises(RegistryFullError):
-        agg.record("c", 1.0)
+        strict.record("c", 1.0)
 
 
 @pytest.mark.parametrize("path", ["scatter", "matmul", "multirow"])
