@@ -1,0 +1,93 @@
+"""Host-fed ingest benchmark (VERDICT r1 item 4): sustained samples/s
+through the FULL host->device path — record_batch staging, one async
+device_put per 8-batch super-chunk, device-side chunk slicing, fused
+compress+scatter-add — unlike the firehose bench, whose samples are
+generated on device and never cross PCIe/host memory.
+
+Usage: python benchmarks/h2d_bench.py [--metrics 10000] [--seconds 5]
+       [--batch 1048576] [--cpu]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+
+def run(num_metrics: int, seconds: float, batch: int) -> dict:
+    import jax
+
+    from loghisto_tpu.config import MetricConfig
+    from loghisto_tpu.parallel.aggregator import TPUAggregator
+
+    cfg = MetricConfig(bucket_limit=4096)
+    agg = TPUAggregator(
+        num_metrics=num_metrics,
+        config=cfg,
+        batch_size=batch,
+        max_metrics=num_metrics,
+    )
+    rng = np.random.default_rng(0)
+    # pre-generate a pool of host batches (shuffled reuse; generation must
+    # not gate the measured path)
+    pool = []
+    for _ in range(8):
+        raw = rng.zipf(1.3, size=batch)
+        ids = ((raw - 1) % num_metrics).astype(np.int32)
+        values = rng.lognormal(10.0, 2.0, batch).astype(np.float32)
+        pool.append((ids, values))
+
+    # warmup: one full flush compiles the ingest executable
+    agg.record_batch(*pool[0])
+    agg.flush(force=True)
+    jax.block_until_ready(agg._acc)
+
+    sent = 0
+    t0 = time.perf_counter()
+    i = 0
+    while time.perf_counter() - t0 < seconds:
+        ids, values = pool[i % len(pool)]
+        agg.record_batch(ids, values)  # auto-flushes at batch_size
+        sent += len(ids)
+        i += 1
+    agg.flush(force=True)
+    jax.block_until_ready(agg._acc)
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": "host-fed samples/sec/chip",
+        "value": round(sent / elapsed, 1),
+        "unit": "samples/s",
+        "platform": jax.devices()[0].platform,
+        "num_metrics": num_metrics,
+        "batch": batch,
+        "seconds": round(elapsed, 2),
+        "shed": agg._shed_samples,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--metrics", type=int, default=10_000)
+    parser.add_argument("--seconds", type=float, default=5.0)
+    parser.add_argument("--batch", type=int, default=1 << 20)
+    parser.add_argument("--cpu", action="store_true")
+    args = parser.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(run(args.metrics, args.seconds, args.batch)))
+
+
+if __name__ == "__main__":
+    main()

@@ -159,7 +159,15 @@ def main() -> int:
 
     stage(outdir, "device_paths")(paths)
 
-    # ---- stage 4: firehose (device-generated load, 10k metrics) ----
+    # ---- stage 4: host-fed H2D pipeline (VERDICT item 4) ----
+    def host_fed():
+        import benchmarks.h2d_bench as h2d
+
+        return h2d.run(num_metrics=10_000, seconds=8.0, batch=1 << 20)
+
+    stage(outdir, "host_fed")(host_fed)
+
+    # ---- stage 5: firehose (device-generated load, 10k metrics) ----
     def firehose():
         from loghisto_tpu import firehose as fh
 

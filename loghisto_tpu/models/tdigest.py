@@ -35,15 +35,21 @@ class TDigestConfig:
     delta: float = 0.0  # 0 -> derived from capacity
 
     def __post_init__(self):
-        if self.capacity < 8:
-            raise ValueError("capacity must be >= 8")
+        if self.capacity < 16:
+            raise ValueError("capacity must be >= 16")
         if self.delta == 0.0:
-            object.__setattr__(self, "delta", 1.6 * self.capacity)
+            # fill ~80% of capacity, bounded so the two reserved extreme
+            # singleton slots (+1 rounding slot) always fit
+            object.__setattr__(
+                self,
+                "delta",
+                min(1.6 * self.capacity, 2.0 * (self.capacity - 3)),
+            )
         if self.delta < 8:
             raise ValueError("delta must be >= 8")
-        if self.delta / 2 + 1 > self.capacity:
+        if self.delta / 2 + 3 > self.capacity:
             raise ValueError(
-                f"delta={self.delta} needs ~{int(self.delta // 2) + 1} "
+                f"delta={self.delta} needs ~{int(self.delta // 2) + 3} "
                 f"cluster slots, more than capacity={self.capacity}"
             )
 
@@ -62,14 +68,28 @@ def _k_scale(q: jnp.ndarray, delta: float) -> jnp.ndarray:
 
 
 def _compress(means, weights, capacity: int, delta: float):
-    """Cluster sorted centroids by k-scale index and segment-reduce."""
+    """Cluster sorted centroids by k-scale index and segment-reduce.
+
+    The lowest and highest populated entries are forced into their own
+    singleton clusters (slots 0 and capacity-1) — Dunning's extreme-
+    centroid rule.  Because a singleton's mean is the value itself, the
+    digest's observed min and max survive every compression EXACTLY, and
+    tail quantiles interpolate toward the true max instead of a smeared
+    cluster mean (the p9999 accuracy fix; see ACCURACY.md)."""
     total = jnp.maximum(weights.sum(), 1e-30)
     # midpoint quantile of each centroid
     cum = jnp.cumsum(weights) - weights / 2.0
     q = cum / total
     k = _k_scale(q, delta)
     cluster = jnp.floor(k - _k_scale(jnp.float32(0.0), delta)).astype(jnp.int32)
-    cluster = jnp.clip(cluster, 0, capacity - 1)
+    # interior clusters live in [1, capacity-2]; 0 and capacity-1 are the
+    # reserved extreme singletons
+    cluster = jnp.clip(cluster + 1, 1, capacity - 2)
+    n = weights.shape[0]
+    pos = jnp.arange(n)
+    n_pop = (weights > 0).sum()
+    cluster = jnp.where(pos == 0, 0, cluster)
+    cluster = jnp.where((pos == n_pop - 1) & (pos > 0), capacity - 1, cluster)
     # zero-weight slots: park them in the last cluster with zero weight
     cluster = jnp.where(weights > 0, cluster, capacity - 1)
     new_w = jax.ops.segment_sum(weights, cluster, num_segments=capacity)
@@ -87,7 +107,19 @@ def _insert(means, weights, values, sample_weights, capacity, delta):
     # sort by mean, zero-weight entries pushed to the end
     key = jnp.where(all_w > 0, all_m, jnp.inf)
     order = jnp.argsort(key)
-    return _compress(all_m[order], all_w[order], capacity, delta)
+    sm, sw = all_m[order], all_w[order]
+    # Small-N exactness: while every populated centroid fits in the slot
+    # array, keep them as singletons — the digest is EXACT below
+    # ~capacity samples (quantiles interpolate the raw data), and k-scale
+    # smearing only begins once clustering is actually necessary.
+    # Populated entries sort to the front, so truncation is lossless in
+    # that branch.
+    n_pop = (sw > 0).sum()
+    return jax.lax.cond(
+        n_pop <= capacity,
+        lambda: (sm[:capacity], sw[:capacity]),
+        lambda: _compress(sm, sw, capacity, delta),
+    )
 
 
 def _pad_pow2(arr: "np_or_jnp", fill: float):
@@ -112,6 +144,16 @@ def insert(
     Batches are padded to the next power of two with weight-0 entries, so
     arbitrary batch sizes reuse O(log N) compiled executables."""
     values = jnp.asarray(values, dtype=jnp.float32)
+    # Library-wide NaN/inf policy (matches the codec: NaN pins to the zero
+    # bucket, magnitudes saturate): NaN -> 0.0, +/-inf -> float32 extremes.
+    # Unsanitized, a NaN/inf mean would sort past the zero-weight +inf
+    # sentinel keys in _insert and be silently dropped from the count.
+    values = jnp.nan_to_num(
+        values,
+        nan=0.0,
+        posinf=jnp.finfo(jnp.float32).max,
+        neginf=jnp.finfo(jnp.float32).min,
+    )
     if sample_weights is None:
         sample_weights = jnp.ones_like(values)
     else:

@@ -235,7 +235,17 @@ class TPUAggregator:
         self.percentiles = dict(percentiles)
         self.batch_size = batch_size
 
+        # Two-lock split so producers never stall on device work
+        # (SURVEY.md §7 hard part (c)):
+        #   _lock     — host staging state (_pending_*, _native_staged);
+        #               held only for list appends/drains, never across a
+        #               device call.
+        #   _dev_lock — device state (_acc, _spill, _interval_ingested,
+        #               growth); held across device dispatches.
+        # Never nested: every method releases one before taking the other,
+        # so lock-ordering deadlocks are impossible by construction.
         self._lock = threading.Lock()
+        self._dev_lock = threading.Lock()
         self._pending_ids: list[np.ndarray] = []
         self._pending_values: list[np.ndarray] = []
         self._pending_count = 0
@@ -246,6 +256,10 @@ class TPUAggregator:
         self.max_pending_samples = 32 * batch_size
         self.retry_cooldown = 1.0  # seconds between device retry attempts
         self._shed_samples = 0
+        # guards _shed_samples, which is incremented from both the staging
+        # side (_bound_pending_locked, under _lock) and the device side
+        # (_on_device_failure_locked, under _dev_lock)
+        self._shed_lock = threading.Lock()
         self._device_down_until = 0.0
         self._interval_ingested = 0  # samples in the live accumulator
 
@@ -391,7 +405,7 @@ class TPUAggregator:
         except RegistryFullError:
             if self.on_registry_full == "error":
                 raise
-        with self._lock:
+        with self._dev_lock:
             try:
                 return self.registry.id_for(name)  # a racer may have grown
             except RegistryFullError:
@@ -420,9 +434,10 @@ class TPUAggregator:
         return 1
 
     def _grow_locked(self, target: Optional[int] = None) -> bool:
-        """Grow the metric-row space in place (caller holds _lock): pad
+        """Grow the metric-row space in place (caller holds _dev_lock): pad
         the accumulator (and spill) with zero rows, re-shard in mesh mode,
-        rebuild the shape-specialized multirow kernel.  Returns False when
+        rebuild the shape-specialized multirow kernel (caller holds
+        _dev_lock — growth mutates device state).  Returns False when
         no growth is possible (max_metrics reached, or the divisibility
         unit leaves no room).  All fallible work happens BEFORE any state
         is committed, so a failed grow leaves the aggregator untouched.
@@ -471,7 +486,8 @@ class TPUAggregator:
 
     def _spill_fold_locked(self) -> None:
         """Fold the device accumulator into the host int64 spill tensor and
-        reset it, WITHOUT closing the interval (caller holds _lock).  Keeps
+        reset it, WITHOUT closing the interval (caller holds _dev_lock).
+        Keeps
         every per-cell device count below spill_threshold + one flush
         round — the int32 overflow guarantee."""
         acc_np = np.asarray(self._finalize_acc(self._acc), dtype=np.int64)
@@ -540,13 +556,15 @@ class TPUAggregator:
                 self._pending_ids.pop(0)
                 self._pending_values.pop(0)
                 self._pending_count -= len(head)
-                self._shed_samples += len(head)
+                with self._shed_lock:
+                    self._shed_samples += len(head)
                 overflow -= len(head)
             else:
                 self._pending_ids[0] = head[overflow:]
                 self._pending_values[0] = self._pending_values[0][overflow:]
                 self._pending_count -= overflow
-                self._shed_samples += overflow
+                with self._shed_lock:
+                    self._shed_samples += overflow
                 overflow = 0
 
     def flush(self, force: bool = False) -> None:
@@ -574,69 +592,103 @@ class TPUAggregator:
         with self._lock:
             if not self._pending_count:
                 return
+            # _device_down_until is written under _dev_lock; this read is
+            # a benign race (cooldown is a heuristic, not an invariant)
             if not force and time.monotonic() < self._device_down_until:
                 return  # device cooling down; keep buffering
             ids = np.concatenate(self._pending_ids)
             values = np.concatenate(self._pending_values)
             self._pending_ids, self._pending_values = [], []
             self._pending_count = 0
-            n = len(ids)
-            bs = self.batch_size
-            padded = (n + bs - 1) // bs * bs
-            if padded != n:
-                ids = np.concatenate(
-                    [ids, np.full(padded - n, -1, dtype=np.int32)]
-                )
-                values = np.concatenate(
-                    [values, np.zeros(padded - n, dtype=np.float32)]
-                )
-            for off in range(0, padded, bs):
+        # staging lock released: producers keep appending while the device
+        # loop below runs (non-blocking flush, SURVEY.md §7 hard part (a))
+        n = len(ids)
+        bs = self.batch_size
+        padded = (n + bs - 1) // bs * bs
+        if padded != n:
+            ids = np.concatenate(
+                [ids, np.full(padded - n, -1, dtype=np.int32)]
+            )
+            values = np.concatenate(
+                [values, np.zeros(padded - n, dtype=np.float32)]
+            )
+        # Transfer in super-chunks of 8 ingest batches: ONE async H2D per
+        # super-chunk (device_put returns before the copy completes, so
+        # the transfer of super-chunk S+1 overlaps the ingest dispatches
+        # of S), per-chunk slicing happens ON DEVICE, and the staging
+        # footprint on device is bounded at 8*batch_size entries even
+        # when a force-flush drains a 32*batch_size host backlog.
+        super_bs = 8 * bs
+        retry_off = None
+        with self._dev_lock:
+            for soff in range(0, padded, super_bs):
+                send = min(soff + super_bs, padded)
                 try:
-                    self._acc = self._ingest(
-                        self._acc, ids[off:off + bs], values[off:off + bs]
-                    )
-                    self._device_down_until = 0.0
-                    self._interval_ingested += min(bs, n - off)
-                    # int32 overflow guarantee: the check must run per
-                    # chunk — a force-flush of a large host backlog could
-                    # otherwise push a hot cell past 2^31 before any
-                    # post-loop check (worst case all samples hit one
-                    # cell; threshold + batch_size < 2^31 is validated
-                    # at construction)
-                    if self._interval_ingested >= self.spill_threshold:
-                        self._spill_fold_locked()
+                    ids_dev = jax.device_put(ids[soff:send])
+                    values_dev = jax.device_put(values[soff:send])
                 except Exception:
-                    import logging
-
-                    logger = logging.getLogger("loghisto_tpu")
-                    self._device_down_until = (
-                        time.monotonic() + self.retry_cooldown
-                    )
-                    # The ingest donates the accumulator; a failure may
-                    # have consumed the buffer.  Detect it — continuing to
-                    # use a deleted array would brick every later flush.
-                    if getattr(self._acc, "is_deleted", lambda: False)():
-                        logger.error(
-                            "device failure consumed the donated "
-                            "accumulator; %d already-ingested samples of "
-                            "this interval are lost",
-                            self._interval_ingested,
-                        )
-                        self._shed_samples += self._interval_ingested
-                        self._interval_ingested = 0
-                        self._acc = self._fresh_acc()
-                    tail = n - off  # real samples only, never the pad
-                    logger.exception(
-                        "device ingest failed; buffering %d samples for "
-                        "retry (cooldown %.1fs)", max(tail, 0),
-                        self.retry_cooldown,
-                    )
-                    if tail > 0:
-                        self._pending_ids.append(ids[off:n])
-                        self._pending_values.append(values[off:n])
-                        self._pending_count += tail
-                    self._bound_pending_locked()
+                    retry_off = soff
+                    self._on_device_failure_locked()
                     break
+                for off in range(soff, send, bs):
+                    lo = off - soff
+                    try:
+                        self._acc = self._ingest(
+                            self._acc,
+                            ids_dev[lo:lo + bs],
+                            values_dev[lo:lo + bs],
+                        )
+                        self._device_down_until = 0.0
+                        self._interval_ingested += min(bs, n - off)
+                        # int32 overflow guarantee: the check must run per
+                        # chunk — a force-flush of a large host backlog
+                        # could otherwise push a hot cell past 2^31
+                        # (worst case all samples hit one cell; threshold
+                        # + batch_size < 2^31 is validated at construction)
+                        if self._interval_ingested >= self.spill_threshold:
+                            self._spill_fold_locked()
+                    except Exception:
+                        retry_off = off
+                        self._on_device_failure_locked()
+                        break
+                if retry_off is not None:
+                    break
+        if retry_off is not None and retry_off < n:
+            import logging
+
+            logging.getLogger("loghisto_tpu").exception(
+                "device ingest failed; buffering %d samples for retry "
+                "(cooldown %.1fs)", n - retry_off, self.retry_cooldown,
+            )
+            with self._lock:
+                # PREPEND: producers kept appending while the device loop
+                # ran unlocked, so these drained samples are older than
+                # anything now in _pending — front insertion keeps the
+                # buffer chronological and _bound_pending_locked's
+                # shed-the-OLDEST policy honest
+                self._pending_ids.insert(0, ids[retry_off:n])
+                self._pending_values.insert(0, values[retry_off:n])
+                self._pending_count += n - retry_off
+                self._bound_pending_locked()
+
+    def _on_device_failure_locked(self) -> None:
+        """Device-failure bookkeeping (caller holds _dev_lock): arm the
+        retry cooldown and recover the donated accumulator if the failed
+        dispatch consumed it — continuing to use a deleted array would
+        brick every later flush."""
+        import logging
+
+        self._device_down_until = time.monotonic() + self.retry_cooldown
+        if getattr(self._acc, "is_deleted", lambda: False)():
+            logging.getLogger("loghisto_tpu").error(
+                "device failure consumed the donated accumulator; %d "
+                "already-ingested samples of this interval are lost",
+                self._interval_ingested,
+            )
+            with self._shed_lock:
+                self._shed_samples += self._interval_ingested
+            self._interval_ingested = 0
+            self._acc = self._fresh_acc()
 
     # -- host-tier bridge ----------------------------------------------- #
 
@@ -669,7 +721,7 @@ class TPUAggregator:
         bidx_np = np.asarray(bidx, dtype=np.int64)
         weights_np = np.asarray(weights, dtype=np.int64)
         total = int(weights_np.sum())
-        with self._lock:
+        with self._dev_lock:
             if (
                 self._interval_ingested + total >= self.spill_threshold
                 or (n and int(weights_np.max()) >= 1 << 30)
@@ -761,7 +813,7 @@ class TPUAggregator:
         # (With reset=False the accumulator keeps flowing, so it must be
         # copied under the lock — a later flush() would otherwise donate
         # the very buffer stats are reading.)
-        with self._lock:
+        with self._dev_lock:
             acc = self._acc
             spill = self._spill
             if reset:

@@ -64,7 +64,7 @@ def save(
 
     if aggregator is not None:
         aggregator.flush()
-        with aggregator._lock:
+        with aggregator._dev_lock:
             # canonical dense layout: snapshots stay portable across
             # ingest_path choices (multirow's lane padding is stripped)
             acc = np.asarray(aggregator._finalize_acc(aggregator._acc))
@@ -192,7 +192,7 @@ def restore(
             )
             for saved_id, new_id in row_map:
                 remapped[new_id] += acc[saved_id]
-            with aggregator._lock:
+            with aggregator._dev_lock:
                 live_cols = aggregator._acc.shape[1]
                 if live_cols != remapped.shape[1]:
                     # re-pad the canonical dense rows into the live

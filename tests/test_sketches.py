@@ -272,3 +272,53 @@ def test_sketches_vmap_over_metrics():
     est = jax.vmap(hll.estimate)(regs2)
     # each row has ~4096 distinct float values
     assert np.all(np.abs(np.asarray(est) / 4096 - 1) < 0.1)
+
+
+def test_tdigest_exact_below_capacity():
+    # round-2 small-N buffering: below ~capacity samples every value is a
+    # singleton centroid, so quantiles interpolate the RAW data — exact at
+    # every midpoint quantile, like a sorted-array estimator
+    cfg = tdigest.TDigestConfig(capacity=256)
+    rng = np.random.default_rng(5)
+    data = rng.pareto(1.5, 200) * 1e3  # heavy tail, N < capacity
+    m, w = tdigest.empty(cfg)
+    for chunk in np.array_split(data, 10):  # incremental small inserts
+        m, w = tdigest.insert(m, w, chunk, config=cfg)
+    assert int(np.asarray(tdigest.count(w))) == 200
+    # every populated centroid is a singleton holding one raw value
+    w_np = np.asarray(w)
+    assert (w_np[w_np > 0] == 1.0).all()
+    got = np.asarray(sorted(np.asarray(m)[w_np > 0]))
+    np.testing.assert_allclose(got, np.sort(data), rtol=1e-6)
+
+
+def test_tdigest_max_survives_compression():
+    # the extreme singleton rule: after many over-capacity inserts, the
+    # top centroid's mean is EXACTLY the observed maximum
+    cfg = tdigest.TDigestConfig(capacity=64)
+    rng = np.random.default_rng(6)
+    m, w = tdigest.empty(cfg)
+    true_max, true_min = -np.inf, np.inf
+    for _ in range(20):
+        chunk = rng.lognormal(5, 2, 500)
+        true_max = max(true_max, chunk.max())
+        true_min = min(true_min, chunk.min())
+        m, w = tdigest.insert(m, w, chunk, config=cfg)
+    m_np, w_np = np.asarray(m), np.asarray(w)
+    pop = m_np[w_np > 0]
+    np.testing.assert_allclose(pop.max(), np.float32(true_max), rtol=1e-6)
+    np.testing.assert_allclose(pop.min(), np.float32(true_min), rtol=1e-6)
+    q = np.asarray(tdigest.quantile(m, w, np.array([0.0, 1.0])))
+    np.testing.assert_allclose(q[1], np.float32(true_max), rtol=1e-6)
+
+
+def test_tdigest_nan_inf_policy():
+    # NaN pins to 0.0, infs saturate to float32 extremes — and critically
+    # the COUNT is preserved (unsanitized they sorted past the zero-weight
+    # sentinels and were silently dropped)
+    cfg = tdigest.TDigestConfig(capacity=16)
+    m, w = tdigest.empty(cfg)
+    m, w = tdigest.insert(
+        m, w, np.array([1.0, np.nan, 2.0, np.inf, -np.inf]), config=cfg
+    )
+    assert float(np.asarray(tdigest.count(w))) == 5.0
