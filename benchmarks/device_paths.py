@@ -63,12 +63,19 @@ def main():
           f"steps={args.steps} buckets={cfg.num_buckets}")
     print(f"{'M':>6} {'path':>10} {'samples/s':>14}")
 
+    from loghisto_tpu.ops.sort_ingest import make_sort_ingest_fn
+
     for m in (1, 16, 256, 10_000):
         ids = rng.integers(0, m, n).astype(np.int32)
         acc = jnp.zeros((m, cfg.num_buckets), dtype=jnp.int32)
         scatter = make_ingest_fn(cfg.bucket_limit)
         dt = bench_fn(scatter, acc, (ids, values), args.steps)
         print(f"{m:>6} {'scatter':>10} {n*args.steps/dt:>14.3e}")
+
+        acc = jnp.zeros((m, cfg.num_buckets), dtype=jnp.int32)
+        sort_fn = make_sort_ingest_fn(cfg.bucket_limit)
+        dt = bench_fn(sort_fn, acc, (ids, values), args.steps)
+        print(f"{m:>6} {'sort':>10} {n*args.steps/dt:>14.3e}")
 
         if m * cfg.num_buckets <= 1 << 23:
             acc = jnp.zeros((m, cfg.num_buckets), dtype=jnp.int32)

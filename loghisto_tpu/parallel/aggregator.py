@@ -184,6 +184,8 @@ class TPUAggregator:
             (num_metrics, num_buckets, platform) via ops/dispatch.py
           * "scatter"  — XLA scatter-add (works everywhere)
           * "matmul"   — one-hot MXU matmul (small metric counts)
+          * "sort"     — sort-deduplicated conflict-free scatter
+            (ops/sort_ingest.py; built for TPU scatter semantics)
           * "multirow" — metric-tiled Pallas kernel (sorted/block-padded;
             single-device only, TPU-targeted, interpret-mode elsewhere)
         All three are bit-identical (tests/test_fast_paths.py,
@@ -288,6 +290,15 @@ class TPUAggregator:
                 "checks could wrap an int32 cell"
             )
         self.spill_threshold = int(spill_threshold)
+        if ingest_path == "sort":
+            # validate BEFORE the accumulator allocation below — the
+            # combined-key bound failing after a multi-GB jnp.zeros is a
+            # worse failure mode than this early raise
+            from loghisto_tpu.ops.sort_ingest import (
+                validate_sort_ingest_shape,
+            )
+
+            validate_sort_ingest_shape(self.max_metrics, config.num_buckets)
         # int64 host fold of pre-spill interval counts (canonical dense
         # layout); engaged only when an interval exceeds spill_threshold
         self._spill: Optional[np.ndarray] = None
@@ -349,6 +360,20 @@ class TPUAggregator:
             self._ingest = make_matmul_ingest_fn(
                 config.bucket_limit, config.precision
             )
+        elif ingest_path == "sort":
+            from loghisto_tpu.ops.sort_ingest import (
+                make_sort_ingest_fn,
+                validate_sort_ingest_shape,
+            )
+
+            # fail HERE, not inside the traced ingest where flush's
+            # failure handling would mask a config error as a down device
+            validate_sort_ingest_shape(
+                self.max_metrics, config.num_buckets
+            )
+            self._ingest = make_sort_ingest_fn(
+                config.bucket_limit, config.precision
+            )
         elif ingest_path == "multirow":
             if mesh is not None:
                 raise ValueError(
@@ -367,8 +392,8 @@ class TPUAggregator:
             self._acc = init()
         else:
             raise ValueError(
-                f"unknown ingest_path {ingest_path!r}: expected 'scatter', "
-                "'matmul', or 'multirow'"
+                f"unknown ingest_path {ingest_path!r}: expected 'auto', "
+                "'scatter', 'matmul', 'sort', or 'multirow'"
             )
         self.ingest_path = ingest_path
         self._weighted_ingest = make_weighted_ingest_fn(config.bucket_limit)
@@ -656,9 +681,11 @@ class TPUAggregator:
         if retry_off is not None and retry_off < n:
             import logging
 
-            logging.getLogger("loghisto_tpu").exception(
-                "device ingest failed; buffering %d samples for retry "
-                "(cooldown %.1fs)", n - retry_off, self.retry_cooldown,
+            # the traceback was already logged inside the except handler
+            # (_on_device_failure_locked); this is just the retry notice
+            logging.getLogger("loghisto_tpu").warning(
+                "buffering %d samples for retry (cooldown %.1fs)",
+                n - retry_off, self.retry_cooldown,
             )
             with self._lock:
                 # PREPEND: producers kept appending while the device loop
@@ -672,12 +699,17 @@ class TPUAggregator:
                 self._bound_pending_locked()
 
     def _on_device_failure_locked(self) -> None:
-        """Device-failure bookkeeping (caller holds _dev_lock): arm the
-        retry cooldown and recover the donated accumulator if the failed
-        dispatch consumed it — continuing to use a deleted array would
-        brick every later flush."""
+        """Device-failure bookkeeping (caller holds _dev_lock, and must
+        call from INSIDE the except handler so the traceback below is
+        still live): log the failure, arm the retry cooldown, and recover
+        the donated accumulator if the failed dispatch consumed it —
+        continuing to use a deleted array would brick every later
+        flush."""
         import logging
 
+        logging.getLogger("loghisto_tpu").exception(
+            "device ingest dispatch failed"
+        )
         self._device_down_until = time.monotonic() + self.retry_cooldown
         if getattr(self._acc, "is_deleted", lambda: False)():
             logging.getLogger("loghisto_tpu").error(

@@ -94,3 +94,73 @@ def test_pallas_row_nan_goes_to_zero_bucket():
         pallas_histogram_row(row, values, CFG.bucket_limit, interpret=True)
     )
     assert got[CFG.bucket_limit] == SAMPLE_TILE  # center bucket
+
+
+def test_sort_ingest_matches_scatter():
+    from loghisto_tpu.ops.ingest import make_ingest_fn
+    from loghisto_tpu.ops.sort_ingest import make_sort_ingest_fn
+
+    cfg = MetricConfig(bucket_limit=256)
+    rng = np.random.default_rng(9)
+    n, m = 1 << 14, 37
+    ids = rng.integers(-2, m + 3, n).astype(np.int32)  # includes invalid
+    values = rng.lognormal(3, 2, n).astype(np.float32)
+    values[:64] = np.nan
+    values[64:128] = 0.0
+    values[128:256] *= -1
+    scatter = make_ingest_fn(cfg.bucket_limit)
+    sort_fn = make_sort_ingest_fn(cfg.bucket_limit)
+    ref = np.asarray(
+        scatter(jnp.zeros((m, cfg.num_buckets), jnp.int32), ids, values)
+    )
+    got = np.asarray(
+        sort_fn(jnp.zeros((m, cfg.num_buckets), jnp.int32), ids, values)
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_sort_ingest_accumulates_and_zipf_hot_cell():
+    from loghisto_tpu.ops.sort_ingest import make_sort_ingest_fn
+
+    cfg = MetricConfig(bucket_limit=64)
+    m = 8
+    # adversarial duplicate concentration: all samples in ONE cell — the
+    # exact workload where duplicate-index scatter serializes
+    ids = np.zeros(4096, dtype=np.int32)
+    values = np.full(4096, 2.5, dtype=np.float32)
+    sort_fn = make_sort_ingest_fn(cfg.bucket_limit)
+    acc = jnp.zeros((m, cfg.num_buckets), jnp.int32)
+    acc = sort_fn(acc, ids, values)
+    acc = sort_fn(acc, ids, values)
+    acc = np.asarray(acc)
+    assert acc.sum() == 8192
+    assert (acc > 0).sum() == 1  # single populated cell
+
+
+def test_sort_ingest_via_aggregator():
+    from loghisto_tpu.parallel.aggregator import TPUAggregator
+
+    agg = TPUAggregator(
+        num_metrics=8, config=MetricConfig(bucket_limit=64),
+        ingest_path="sort", batch_size=512,
+    )
+    rng = np.random.default_rng(4)
+    for i in range(8):
+        agg.registry.id_for(f"m{i}")
+    ids = rng.integers(0, 8, 4096).astype(np.int32)
+    vals = rng.lognormal(1, 1, 4096).astype(np.float32)
+    agg.record_batch(ids, vals)
+    out = agg.collect().metrics
+    assert sum(
+        out[f"m{i}_count"] for i in range(8)
+    ) == 4096
+
+
+def test_sort_ingest_shape_validated_at_construction():
+    from loghisto_tpu.parallel.aggregator import TPUAggregator
+
+    with pytest.raises(ValueError, match="combined int32 cell key"):
+        TPUAggregator(
+            num_metrics=1 << 18, config=MetricConfig(bucket_limit=4096),
+            ingest_path="sort", max_metrics=1 << 18,
+        )

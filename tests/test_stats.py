@@ -191,27 +191,3 @@ def test_dense_stats_many_metrics(cfg):
     np.testing.assert_allclose(
         np.asarray(out["percentiles"]), np.stack(sparse_out), rtol=1e-4
     )
-
-
-def test_search_formulations_agree():
-    # the TPU one-pass count-below search and the CPU binary search must
-    # select identical buckets for any cumsum/threshold combination
-    # (CI runs on CPU, where dense_stats only ever takes the binary
-    # branch via lax.platform_dependent — this pins the other branch)
-    from loghisto_tpu.ops.stats import search_binary, search_count_below
-
-    rng = np.random.default_rng(11)
-    for _ in range(20):
-        m, b = int(rng.integers(1, 40)), int(rng.integers(2, 300))
-        counts = rng.integers(0, 50, size=(m, b))
-        counts[rng.random((m, b)) < 0.7] = 0  # sparse rows, some empty
-        cdf = jnp.asarray(np.cumsum(counts, axis=1, dtype=np.int32))
-        totals = np.maximum(counts.sum(axis=1), 1)
-        ps = rng.random((m, 7))
-        k_star = jnp.asarray(
-            np.maximum(np.ceil(ps * totals[:, None]), 1).astype(np.int32)
-        )
-        np.testing.assert_array_equal(
-            np.asarray(search_count_below(cdf, k_star)),
-            np.asarray(search_binary(cdf, k_star)),
-        )
