@@ -68,6 +68,11 @@ def save(
             # canonical dense layout: snapshots stay portable across
             # ingest_path choices (multirow's lane padding is stripped)
             acc = np.asarray(aggregator._finalize_acc(aggregator._acc))
+            # a spilled interval keeps part of its counts in the host
+            # int64 fold — snapshotting only the device tensor would
+            # silently lose them; the combined snapshot is int64
+            if aggregator._spill is not None:
+                acc = acc.astype(np.int64) + aggregator._spill
         with aggregator._agg_lock:
             agg_items = sorted(aggregator._agg.items())
         payload["agg_acc"] = acc
@@ -193,17 +198,42 @@ def restore(
             for saved_id, new_id in row_map:
                 remapped[new_id] += acc[saved_id]
             with aggregator._dev_lock:
-                live_cols = aggregator._acc.shape[1]
-                if live_cols != remapped.shape[1]:
-                    # re-pad the canonical dense rows into the live
-                    # (lane-padded) layout
-                    padded = np.zeros(
-                        (aggregator.num_metrics, live_cols),
-                        dtype=remapped.dtype,
+                # int64 snapshots (taken mid-spill) or counts too large
+                # for the int32 device tensor merge into the host spill
+                # instead — collect() folds spill + device exactly.  The
+                # live accumulator's hottest cell joins the headroom
+                # check: restored counts never increment
+                # _interval_ingested, so successive restores (merging
+                # several worker checkpoints) would otherwise stack
+                # toward 2^31 unseen by the spill trigger.
+                live_max = int(
+                    jnp.max(aggregator._finalize_acc(aggregator._acc))
+                )
+                if (
+                    int(remapped.max(initial=0))
+                    + live_max
+                    + aggregator.spill_threshold
+                    + aggregator.batch_size
+                ) >= 2**31:
+                    if aggregator._spill is None:
+                        aggregator._spill = remapped.astype(np.int64)
+                    else:
+                        aggregator._spill += remapped.astype(np.int64)
+                else:
+                    live_cols = aggregator._acc.shape[1]
+                    dense = remapped.astype(np.int32)
+                    if live_cols != dense.shape[1]:
+                        # re-pad the canonical dense rows into the live
+                        # (lane-padded) layout
+                        padded = np.zeros(
+                            (aggregator.num_metrics, live_cols),
+                            dtype=np.int32,
+                        )
+                        padded[:, :dense.shape[1]] = dense
+                        dense = padded
+                    aggregator._acc = (
+                        aggregator._acc + jnp.asarray(dense)
                     )
-                    padded[:, :remapped.shape[1]] = remapped
-                    remapped = padded
-                aggregator._acc = aggregator._acc + jnp.asarray(remapped)
             id_remap = dict(row_map)
             with aggregator._agg_lock:
                 agg_compat = aggregator.config.go_compat

@@ -152,3 +152,73 @@ def test_atomic_write_leaves_no_tmp(tmp_path):
     checkpoint.save(path, metric_system=ms)  # overwrite is atomic
     leftovers = [p for p in tmp_path.iterdir() if p.suffix == ".tmp"]
     assert not leftovers
+
+
+def test_checkpoint_preserves_spill(tmp_path):
+    # a snapshot taken mid-spill must carry the host int64 fold: losing it
+    # silently would drop every sample past spill_threshold
+    import datetime
+
+    from loghisto_tpu.metrics import RawMetricSet
+
+    cfg = MetricConfig(bucket_limit=64)
+    agg = TPUAggregator(num_metrics=2, config=cfg, batch_size=64)
+    agg.registry.id_for("hot")
+    big = (1 << 31) + 777  # forces the spill path in merge_raw
+    raw = RawMetricSet(
+        time=datetime.datetime.now(tz=datetime.timezone.utc),
+        counters={}, rates={}, histograms={"hot": {10: big}}, gauges={},
+    )
+    agg.merge_raw(raw)
+    assert agg._spill is not None
+
+    path = str(tmp_path / "spill.npz")
+    checkpoint.save(path, aggregator=agg)
+
+    agg2 = TPUAggregator(num_metrics=2, config=cfg, batch_size=64)
+    checkpoint.restore(path, aggregator=agg2)
+    # counts too large for int32 land in the restored aggregator's spill
+    assert agg2._spill is not None
+    out = agg2.collect().metrics
+    assert out["hot_count"] == float(big)
+
+
+def test_checkpoint_small_restore_stays_on_device(tmp_path):
+    cfg = MetricConfig(bucket_limit=64)
+    agg = TPUAggregator(num_metrics=2, config=cfg, batch_size=64)
+    agg.record("a", 0.5)
+    agg.flush(force=True)
+    path = str(tmp_path / "small.npz")
+    checkpoint.save(path, aggregator=agg)
+    agg2 = TPUAggregator(num_metrics=2, config=cfg, batch_size=64)
+    checkpoint.restore(path, aggregator=agg2)
+    assert agg2._spill is None  # int32-safe restores stay on device
+    assert agg2.collect().metrics["a_count"] == 1.0
+
+
+def test_successive_restores_route_to_spill(tmp_path):
+    # restored counts never increment the spill trigger's interval
+    # counter, so stacking several worker checkpoints must divert to the
+    # int64 spill once the combined headroom approaches 2^31
+    import datetime
+
+    from loghisto_tpu.metrics import RawMetricSet
+
+    cfg = MetricConfig(bucket_limit=64)
+    agg = TPUAggregator(num_metrics=2, config=cfg, batch_size=64)
+    agg.registry.id_for("hot")
+    per_worker = 900_000_000  # ~0.9e9: one restore fits, two would wrap
+    raw = RawMetricSet(
+        time=datetime.datetime.now(tz=datetime.timezone.utc),
+        counters={}, rates={}, histograms={"hot": {10: per_worker}},
+        gauges={},
+    )
+    agg.merge_raw(raw)
+    path = str(tmp_path / "worker.npz")
+    checkpoint.save(path, aggregator=agg)
+
+    target = TPUAggregator(num_metrics=2, config=cfg, batch_size=64)
+    checkpoint.restore(path, aggregator=target)
+    checkpoint.restore(path, aggregator=target)  # second worker merge
+    out = target.collect().metrics
+    assert out["hot_count"] == float(2 * per_worker)  # no int32 wrap

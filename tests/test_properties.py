@@ -128,3 +128,55 @@ def test_dense_and_sparse_tiers_agree(bucket_counts, ps):
     )
     # float32 representatives vs float64: compare within float32 eps
     np.testing.assert_allclose(dense, sparse, rtol=1e-5)
+
+
+@given(
+    st.lists(
+        st.tuples(st.integers(-3, 12), st.floats(-1e6, 1e6, allow_nan=False)),
+        min_size=1, max_size=300,
+    )
+)
+@settings(max_examples=60, deadline=None)
+def test_sort_ingest_always_matches_scatter(samples):
+    import jax.numpy as jnp
+
+    from loghisto_tpu.ops.ingest import ingest_batch
+    from loghisto_tpu.ops.sort_ingest import sort_ingest_batch
+
+    m, bl = 8, 32
+    ids = np.array([s[0] for s in samples], dtype=np.int32)
+    values = np.array([s[1] for s in samples], dtype=np.float32)
+    acc = jnp.zeros((m, 2 * bl + 1), dtype=jnp.int32)
+    ref = np.asarray(ingest_batch(acc, ids, values, bl))
+    got = np.asarray(sort_ingest_batch(acc, ids, values, bl))
+    np.testing.assert_array_equal(got, ref)
+
+
+@given(
+    st.lists(
+        st.tuples(st.integers(0, 6), st.integers(-200, 200),
+                  st.integers(1, 5000)),
+        min_size=1, max_size=100,
+    ),
+    st.lists(st.floats(0.0, 1.0), min_size=1, max_size=6),
+)
+@settings(max_examples=60, deadline=None)
+def test_hierarchical_dense_stats_matches_int64_oracle(entries, ps):
+    """The device tier's two-level rank search must select the same
+    buckets as the exact int64 host oracle (dense_stats_np) for any
+    histogram — including block-boundary and single-bucket cases."""
+    import jax.numpy as jnp
+
+    from loghisto_tpu.ops.stats import dense_stats, dense_stats_np
+
+    m, bl = 7, 256
+    acc = np.zeros((m, 2 * bl + 1), dtype=np.int32)
+    for mid, bucket, count in entries:
+        acc[mid, np.clip(bucket, -bl, bl) + bl] += count
+    ps_arr = np.asarray(sorted(set(ps)), dtype=np.float32)
+    got = dense_stats(jnp.asarray(acc), ps_arr, bl)
+    want = dense_stats_np(acc, ps_arr.astype(np.float64), bl)
+    np.testing.assert_array_equal(np.asarray(got["counts"]), want["counts"])
+    np.testing.assert_allclose(
+        np.asarray(got["percentiles"]), want["percentiles"], rtol=2e-6
+    )
