@@ -59,46 +59,68 @@ def main():
     rng = np.random.default_rng(0)
     n = args.batch // SAMPLE_TILE * SAMPLE_TILE
     values = rng.lognormal(8, 2, n).astype(np.float32)
-    print(f"platform={jax.devices()[0].platform} batch={n} "
+    platform = jax.devices()[0].platform
+    print(f"platform={platform} batch={n} "
           f"steps={args.steps} buckets={cfg.num_buckets}")
     print(f"{'M':>6} {'path':>10} {'samples/s':>14}")
 
     from loghisto_tpu.ops.sort_ingest import make_sort_ingest_fn
 
+    # each path runs isolated: one path's lowering failure must not lose
+    # the rest of the table (the r2_a1 capture lost scatter/matmul/sort
+    # data to a single Pallas lowering rejection)
+    results = {"platform": platform, "batch": n, "steps": args.steps,
+               "num_buckets": cfg.num_buckets, "rates": {}, "errors": {}}
+
+    def run_path(m, name, fn, acc, fn_args):
+        import traceback
+
+        try:
+            dt = bench_fn(fn, acc, fn_args, args.steps)
+            rate = n * args.steps / dt
+            results["rates"][f"{name}@{m}"] = rate
+            print(f"{m:>6} {name:>10} {rate:>14.3e}")
+        except Exception as e:
+            results["errors"][f"{name}@{m}"] = (
+                traceback.format_exc(limit=3).strip().splitlines()[-1]
+            )
+            print(f"{m:>6} {name:>10} {'FAILED: ' + type(e).__name__:>14}")
+
     for m in (1, 16, 256, 10_000):
         ids = rng.integers(0, m, n).astype(np.int32)
         acc = jnp.zeros((m, cfg.num_buckets), dtype=jnp.int32)
-        scatter = make_ingest_fn(cfg.bucket_limit)
-        dt = bench_fn(scatter, acc, (ids, values), args.steps)
-        print(f"{m:>6} {'scatter':>10} {n*args.steps/dt:>14.3e}")
+        run_path(m, "scatter", make_ingest_fn(cfg.bucket_limit), acc,
+                 (ids, values))
 
         acc = jnp.zeros((m, cfg.num_buckets), dtype=jnp.int32)
-        sort_fn = make_sort_ingest_fn(cfg.bucket_limit)
-        dt = bench_fn(sort_fn, acc, (ids, values), args.steps)
-        print(f"{m:>6} {'sort':>10} {n*args.steps/dt:>14.3e}")
+        run_path(m, "sort", make_sort_ingest_fn(cfg.bucket_limit), acc,
+                 (ids, values))
 
         if m * cfg.num_buckets <= 1 << 23:
             acc = jnp.zeros((m, cfg.num_buckets), dtype=jnp.int32)
-            matmul = make_matmul_ingest_fn(cfg.bucket_limit)
-            dt = bench_fn(matmul, acc, (ids, values), args.steps)
-            print(f"{m:>6} {'matmul':>10} {n*args.steps/dt:>14.3e}")
+            run_path(m, "matmul", make_matmul_ingest_fn(cfg.bucket_limit),
+                     acc, (ids, values))
 
         if m == 1:
             row = jnp.zeros(cfg.num_buckets, dtype=jnp.int32)
-            pal = make_pallas_row_ingest(cfg.num_buckets, cfg.bucket_limit)
-            dt = bench_fn(pal, row, (values,), args.steps)
-            print(f"{m:>6} {'pallas':>10} {n*args.steps/dt:>14.3e}")
+            run_path(m, "pallas",
+                     make_pallas_row_ingest(cfg.num_buckets, cfg.bucket_limit),
+                     row, (values,))
 
-        if m >= 16 and jax.devices()[0].platform == "tpu":
+        if m >= 16 and platform == "tpu":
             # metric-tiled pallas path (interpret mode is far too slow off
             # TPU, and the pltpu lowering only targets TPU)
             from loghisto_tpu.ops.pallas_multirow import make_multirow_ingest
 
-            init, mingest, _ = make_multirow_ingest(
-                m, cfg.bucket_limit, rows_tile=8
-            )
-            dt = bench_fn(mingest, init(), (ids, values), args.steps)
-            print(f"{m:>6} {'multirow':>10} {n*args.steps/dt:>14.3e}")
+            try:
+                init, mingest, _ = make_multirow_ingest(
+                    m, cfg.bucket_limit, rows_tile=8
+                )
+                run_path(m, "multirow", mingest, init(), (ids, values))
+            except Exception as e:
+                results["errors"][f"multirow@{m}"] = repr(e)
+                print(f"{m:>6} {'multirow':>10} {'FAILED':>14}")
+    return results
 
 
 if __name__ == "__main__":

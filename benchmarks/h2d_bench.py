@@ -23,7 +23,8 @@ import sys as _sys
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
 
-def run(num_metrics: int, seconds: float, batch: int) -> dict:
+def run(num_metrics: int, seconds: float, batch: int,
+        transport: str = "auto") -> dict:
     import jax
 
     from loghisto_tpu.config import MetricConfig
@@ -35,6 +36,7 @@ def run(num_metrics: int, seconds: float, batch: int) -> dict:
         config=cfg,
         batch_size=batch,
         max_metrics=num_metrics,
+        transport=transport,
     )
     rng = np.random.default_rng(0)
     # pre-generate a pool of host batches (shuffled reuse; generation must
@@ -71,6 +73,7 @@ def run(num_metrics: int, seconds: float, batch: int) -> dict:
         "value": round(delivered / elapsed, 1),
         "unit": "samples/s",
         "platform": jax.devices()[0].platform,
+        "transport": agg.transport,
         "num_metrics": num_metrics,
         "batch": batch,
         "seconds": round(elapsed, 2),
@@ -83,6 +86,8 @@ def main() -> None:
     parser.add_argument("--metrics", type=int, default=10_000)
     parser.add_argument("--seconds", type=float, default=5.0)
     parser.add_argument("--batch", type=int, default=1 << 20)
+    parser.add_argument("--transport", default="auto",
+                        choices=("auto", "raw", "preagg"))
     parser.add_argument("--cpu", action="store_true")
     args = parser.parse_args()
 
@@ -90,7 +95,8 @@ def main() -> None:
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
-    print(json.dumps(run(args.metrics, args.seconds, args.batch)))
+    print(json.dumps(run(args.metrics, args.seconds, args.batch,
+                         transport=args.transport)))
 
 
 if __name__ == "__main__":

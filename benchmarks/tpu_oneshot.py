@@ -152,20 +152,30 @@ def main() -> int:
         argv, sys.argv = sys.argv, ["device_paths.py", "--batch", str(1 << 22),
                                     "--steps", "8"]
         try:
-            dp.main()
+            return dp.main()
         finally:
             sys.argv = argv
-        return {"ok": True, "note": "table printed to log"}
 
     stage(outdir, "device_paths")(paths)
 
-    # ---- stage 4: host-fed H2D pipeline (VERDICT item 4) ----
+    # ---- stage 4: host-fed H2D pipeline (VERDICT item 4), both
+    # transports: preagg (host compress+dedup, O(cells) wire) vs raw
+    # (O(samples) wire — tunnel-bandwidth-bound in this environment) ----
     def host_fed():
         import benchmarks.h2d_bench as h2d
 
-        return h2d.run(num_metrics=10_000, seconds=8.0, batch=1 << 20)
+        return h2d.run(num_metrics=10_000, seconds=8.0, batch=1 << 20,
+                       transport="preagg")
 
     stage(outdir, "host_fed")(host_fed)
+
+    def host_fed_raw():
+        import benchmarks.h2d_bench as h2d
+
+        return h2d.run(num_metrics=10_000, seconds=6.0, batch=1 << 20,
+                       transport="raw")
+
+    stage(outdir, "host_fed_raw")(host_fed_raw)
 
     # ---- stage 5: firehose (device-generated load, 10k metrics) ----
     def firehose():

@@ -18,6 +18,13 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     echo "=== CAPTURED on attempt $ATTEMPT; results in $OUT ==="
     exit 0
   fi
+  # Preserve any per-stage results a partial run flushed before the
+  # tunnel wedged — stage JSONs are the whole point of the capture
+  if ls "$OUT"/*.json >/dev/null 2>&1; then
+    mkdir -p TPU_CAPTURE_partial
+    cp -n "$OUT"/* TPU_CAPTURE_partial/ 2>/dev/null
+    echo "=== attempt $ATTEMPT partial: kept stage results in TPU_CAPTURE_partial ==="
+  fi
   # rc=2: init reached a non-TPU platform; rc=124: timeout/wedge
   echo "=== attempt $ATTEMPT failed rc=$rc; sleeping 300s ==="
   rm -rf "$OUT" 2>/dev/null

@@ -128,6 +128,13 @@ def _load():
             ctypes.c_int64, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_int32,
         ]
+        lib.lh_preaggregate.restype = ctypes.c_int64
+        lib.lh_preaggregate.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
         _lib = lib
         return _lib
 
@@ -203,6 +210,35 @@ def compress(values: np.ndarray, precision: int = 100) -> np.ndarray:
     out = np.empty(len(values), dtype=np.int16)
     lib.lh_compress(_f64(values), len(values), precision, _i16(out))
     return out
+
+
+def preaggregate(
+    ids: np.ndarray, values: np.ndarray, bucket_limit: int,
+    precision: int = 100,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Compress + dedup one batch into unique (id, codec_bucket, count)
+    cells — the host-side transport compressor for H2D ingest.  Returns
+    (ids int32[m], codec_buckets int32[m], counts int64[m])."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_build_error}")
+    ids = np.ascontiguousarray(ids, dtype=np.int32)
+    values = np.ascontiguousarray(values, dtype=np.float32)
+    if ids.shape != values.shape:
+        raise ValueError("ids and values must have the same shape")
+    n = len(ids)
+    ids_out = np.empty(n, dtype=np.int32)
+    buckets_out = np.empty(n, dtype=np.int32)
+    counts_out = np.empty(n, dtype=np.int64)
+    m = lib.lh_preaggregate(
+        _i32(ids), values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n, precision, bucket_limit, _i32(ids_out), _i32(buckets_out),
+        counts_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if m < 0:
+        raise MemoryError("lh_preaggregate allocation failed")
+    return (ids_out[:m].copy(), buckets_out[:m].copy(),
+            counts_out[:m].copy())
 
 
 def accumulate_dense(

@@ -141,6 +141,68 @@ void lh_decompress(const int16_t* buckets, int64_t n, int precision,
   }
 }
 
+// Host-side pre-aggregation: compress + dedup one (ids, values) batch into
+// unique (id, codec_bucket) cells with int64 counts, via an open-addressing
+// hash table.  This is the transport compressor for host->device ingest:
+// a Zipf batch of millions of samples collapses to a few thousand cells,
+// so the wire carries O(unique cells) instead of O(samples) — the same
+// local-aggregate-before-network shape as the multi-host psum design.
+// Negative ids (registry-shed samples) are skipped.  Returns the number
+// of unique cells written (<= n), or -1 on allocation failure.
+int64_t lh_preaggregate(const int32_t* ids, const float* values, int64_t n,
+                        int precision, int bucket_limit, int32_t* ids_out,
+                        int32_t* buckets_out, int64_t* counts_out) {
+  if (n <= 0) return 0;
+  struct Slot {
+    uint64_t key;
+    int64_t count;
+  };
+  uint64_t cap = 1;
+  while (cap < static_cast<uint64_t>(n) * 2) cap <<= 1;
+  std::vector<Slot> table;
+  try {
+    table.assign(cap, Slot{0, 0});
+  } catch (...) {
+    return -1;
+  }
+  const uint64_t mask = cap - 1;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t id = ids[i];
+    if (id < 0) continue;
+    int32_t b = compress_one(static_cast<double>(values[i]), precision);
+    if (b < -bucket_limit) b = -bucket_limit;
+    if (b > bucket_limit) b = bucket_limit;
+    // (b + 32768) >= 1 because |b| <= 32767, so key is never the empty
+    // sentinel 0
+    uint64_t key =
+        (static_cast<uint64_t>(static_cast<uint32_t>(id)) << 16) |
+        static_cast<uint16_t>(b + 32768);
+    uint64_t h = key * 0x9E3779B97F4A7C15ull;
+    uint64_t j = (h ^ (h >> 32)) & mask;
+    while (true) {
+      if (table[j].key == key) {
+        ++table[j].count;
+        break;
+      }
+      if (table[j].key == 0) {
+        table[j].key = key;
+        table[j].count = 1;
+        break;
+      }
+      j = (j + 1) & mask;
+    }
+  }
+  int64_t m = 0;
+  for (const Slot& s : table) {
+    if (s.key == 0) continue;
+    ids_out[m] = static_cast<int32_t>(s.key >> 16);
+    buckets_out[m] = static_cast<int32_t>(s.key & 0xFFFF) - 32768;
+    counts_out[m] = s.count;
+    ++m;
+  }
+  return m;
+}
+
 // Dense accumulate on host: the CPU fallback / verification twin of the
 // device scatter-add kernel. acc is uint32[num_metrics][2*bucket_limit+1].
 void lh_accumulate_dense(const int32_t* ids, const double* values, int64_t n,

@@ -113,7 +113,11 @@ def pallas_histogram_row(
 
     acc2d = jnp.zeros((h, LANES), dtype=jnp.int32)
     acc2d = acc2d.reshape(-1).at[:b].set(acc_row).reshape(h, LANES)
-    values2d = values.reshape(g, SAMPLE_TILE)
+    # Mosaic requires each of a block's last two dims to be 8/128-divisible
+    # OR equal to the array dim — so grid the LANE axis of a [1, N] layout
+    # (block [1, T]: dim -2 equals the array's 1, dim -1 is 128-divisible);
+    # a [g, T] layout with block [1, T] is rejected on hardware.
+    values2d = values.reshape(1, n)
 
     kernel = functools.partial(
         _hist_kernel, bucket_limit=bucket_limit, precision=precision, h=h
@@ -122,7 +126,7 @@ def pallas_histogram_row(
         kernel,
         grid=(g,),
         in_specs=[
-            pl.BlockSpec((1, SAMPLE_TILE), lambda i: (i, 0),
+            pl.BlockSpec((1, SAMPLE_TILE), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((h, LANES), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),

@@ -173,8 +173,11 @@ def make_multirow_ingest(
             num_scalar_prefetch=1,
             grid=(g,),
             in_specs=[
-                pl.BlockSpec((1, SAMPLE_TILE), lambda i, tb: (i, 0)),
-                pl.BlockSpec((1, SAMPLE_TILE), lambda i, tb: (i, 0)),
+                # lane-axis grid over a [1, G*T] layout: Mosaic rejects
+                # block [1, T] on a [G, T] array (dim -2 must be
+                # 8-divisible or equal the array dim — see pallas_kernels)
+                pl.BlockSpec((1, SAMPLE_TILE), lambda i, tb: (0, i)),
+                pl.BlockSpec((1, SAMPLE_TILE), lambda i, tb: (0, i)),
                 pl.BlockSpec((rows_tile, b_pad), lambda i, tb: (tb[i], 0)),
             ],
             out_specs=pl.BlockSpec(
@@ -191,8 +194,8 @@ def make_multirow_ingest(
             interpret=interpret,
         )(
             tile_block,
-            rows.reshape(g, SAMPLE_TILE),
-            bidx.reshape(g, SAMPLE_TILE),
+            rows.reshape(1, g * SAMPLE_TILE),
+            bidx.reshape(1, g * SAMPLE_TILE),
             acc,
         )
 

@@ -70,7 +70,9 @@ def test_attach_bridges_host_intervals_to_device():
         ms.histogram("histogram1", v)
     ms.start()
     try:
-        deadline = time.time() + 5
+        # generous deadline: the first collect() pays the stats-fn XLA
+        # compile, which on a cold container can take tens of seconds
+        deadline = time.time() + 90
         while time.time() < deadline:
             out = agg.collect(reset=False).metrics
             if out.get("histogram1_count") == 3:
@@ -80,6 +82,38 @@ def test_attach_bridges_host_intervals_to_device():
         # the golden 331132 decompressed sum survives the device path
         # (float32 matvec: within float tolerance)
         assert abs(out["histogram1_sum"] / 331132.0 - 1) < 1e-4
+    finally:
+        agg.detach()
+        ms.stop()
+
+
+def test_bridge_resubscribes_after_eviction():
+    """Strike-eviction (reaper closes a full channel, metrics.go:565-581)
+    must not kill the bridge permanently: it re-subscribes on a fresh
+    channel and later intervals still reach the device accumulator."""
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    agg = TPUAggregator(num_metrics=8, config=MetricConfig())
+    agg.attach(ms)
+    try:
+        evicted_ch = agg._bridge_ch
+        evicted_ch.close()  # what the reaper's eviction does
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if agg._bridge_evictions >= 1 and agg._bridge_ch is not evicted_ch:
+                break
+            time.sleep(0.02)
+        assert agg._bridge_evictions >= 1
+        assert agg._bridge_ch is not evicted_ch
+        ms.histogram("after_eviction", 7.0)
+        ms.start()
+        deadline = time.time() + 90
+        out = {}
+        while time.time() < deadline:
+            out = agg.collect(reset=False).metrics
+            if out.get("after_eviction_count") == 1:
+                break
+            time.sleep(0.05)
+        assert out.get("after_eviction_count") == 1
     finally:
         agg.detach()
         ms.stop()
@@ -213,3 +247,90 @@ def test_aggregator_rejects_malformed_percentile_labels():
         TPUAggregator(
             num_metrics=4, config=CFG, percentiles={"%d_bad": 0.5}
         )
+
+
+def test_preagg_transport_bit_parity_with_raw():
+    """transport='preagg' (host compress+dedup, weighted scatter) must be
+    bit-identical to transport='raw' (device compress) — the codec is the
+    same formula in both tiers."""
+    from loghisto_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(7)
+    n = 40_000
+    ids = rng.integers(0, 16, n).astype(np.int32)
+    values = np.concatenate([
+        rng.lognormal(4, 2, n - 3).astype(np.float32),
+        np.array([0.0, -5.5, np.nan], dtype=np.float32),
+    ])
+    outs = {}
+    for transport in ("raw", "preagg"):
+        agg = TPUAggregator(
+            num_metrics=16, config=CFG, transport=transport,
+            batch_size=4096,
+        )
+        for name_id in range(16):
+            agg.registry.id_for(f"m{name_id}")
+        agg.record_batch(ids, values)
+        agg.flush(force=True)
+        outs[transport] = np.asarray(agg._finalize_acc(agg._acc))
+    np.testing.assert_array_equal(outs["raw"], outs["preagg"])
+
+
+def test_preagg_transport_spill_threshold_respected():
+    """A preagg flush whose total would cross spill_threshold must fold
+    into the exact host spill, same as the raw path's guarantee."""
+    from loghisto_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native library unavailable")
+    agg = TPUAggregator(
+        num_metrics=4, config=CFG, transport="preagg",
+        batch_size=4096, spill_threshold=10_000,
+    )
+    agg.registry.id_for("hot")
+    ids = np.zeros(20_000, dtype=np.int32)
+    values = np.full(20_000, 42.0, dtype=np.float32)
+    agg.record_batch(ids, values)
+    agg.flush(force=True)
+    assert agg._spill is not None
+    assert agg._spill.sum() == 20_000
+    out = agg.collect().metrics
+    assert out["hot_count"] == 20_000
+
+
+def test_partial_merge_failure_never_double_counts(monkeypatch):
+    """A device failure mid-way through a multi-chunk cell merge must
+    spill ONLY the unapplied remainder: total observed count == total
+    ingested, never more (reproduces the r2 review's 12-in/32-out bug)."""
+    from loghisto_tpu import _native
+    from loghisto_tpu.parallel import aggregator as agg_mod
+
+    if not _native.available():
+        pytest.skip("native library unavailable")
+    monkeypatch.setattr(agg_mod, "_MERGE_CHUNK", 4)
+    agg = TPUAggregator(
+        num_metrics=8, config=CFG, transport="preagg", batch_size=64,
+    )
+    for i in range(8):
+        agg.registry.id_for(f"m{i}")
+    calls = {"n": 0}
+    real = agg._weighted_ingest
+
+    def flaky(acc, ids, buckets, weights):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("injected device failure")
+        return real(acc, ids, buckets, weights)
+
+    agg._weighted_ingest = flaky
+    # 12 samples across 12 distinct cells -> 3 chunks of 4
+    ids = np.arange(12, dtype=np.int32) % 8
+    values = (np.arange(12) * 10 + 1).astype(np.float32)
+    agg.record_batch(ids, values)
+    agg.flush(force=True)
+    out = agg.collect().metrics
+    total = sum(v for k, v in out.items()
+                if k.endswith("_count") and not k.endswith("_agg_count"))
+    assert total == 12, total

@@ -105,3 +105,41 @@ def test_native_ingest_throughput_sanity():
     rate = 32 * (1 << 16) / elapsed
     assert rate > 1e6, rate
     buf.close()
+
+
+def test_native_preaggregate_matches_numpy_oracle():
+    rng = np.random.default_rng(2)
+    limit = 512
+    n = 50_000
+    ids = rng.integers(-1, 40, n).astype(np.int32)  # incl. shed ids (-1)
+    vals = np.concatenate([
+        rng.lognormal(3, 2, n - 4).astype(np.float32),
+        np.array([0.0, np.nan, np.inf, -np.inf], dtype=np.float32),
+    ])
+    uids, ubuckets, uweights = _native.preaggregate(ids, vals, limit)
+
+    ok = ids >= 0
+    buckets = np.clip(
+        compress_np(vals[ok].astype(np.float64)), -limit, limit
+    ).astype(np.int64)
+    keys = ids[ok].astype(np.int64) * 100_000 + buckets + limit
+    want_keys, want_counts = np.unique(keys, return_counts=True)
+
+    got = {(int(i), int(b)): int(w)
+           for i, b, w in zip(uids, ubuckets, uweights)}
+    want = {(int(k // 100_000), int(k % 100_000) - limit): int(c)
+            for k, c in zip(want_keys, want_counts)}
+    assert got == want
+    assert int(uweights.sum()) == int(ok.sum())
+
+
+def test_native_preaggregate_nan_matches_device_contract():
+    # NaN pins to bucket 0 in every tier (compress_one and the jnp codec)
+    uids, ubuckets, uweights = _native.preaggregate(
+        np.zeros(3, dtype=np.int32),
+        np.array([np.nan, np.nan, np.nan], dtype=np.float32),
+        512,
+    )
+    assert uids.tolist() == [0]
+    assert ubuckets.tolist() == [0]
+    assert uweights.tolist() == [3]
