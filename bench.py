@@ -203,9 +203,9 @@ def main() -> None:
     try:
         from benchmarks.h2d_bench import run as h2d_run
 
-        result["host_fed_samples_per_s"] = h2d_run(
-            num_metrics=NUM_METRICS, seconds=5.0, batch=1 << 20
-        )["value"]
+        h2d = h2d_run(num_metrics=NUM_METRICS, seconds=5.0, batch=1 << 20)
+        result["host_fed_samples_per_s"] = h2d["value"]
+        result["host_fed_transport"] = h2d["transport"]
     except Exception as e:  # never let the extra metric kill the bench
         print(f"bench: host-fed stage failed: {e}", file=sys.stderr)
     ready2.set()

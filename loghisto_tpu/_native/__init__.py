@@ -128,12 +128,21 @@ def _load():
             ctypes.c_int64, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_int32,
         ]
-        lib.lh_preaggregate.restype = ctypes.c_int64
-        lib.lh_preaggregate.argtypes = [
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
-            ctypes.c_int64, ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int64),
+        lib.lh_cells_create.restype = ctypes.c_void_p
+        lib.lh_cells_create.argtypes = [ctypes.c_int64]
+        lib.lh_cells_destroy.argtypes = [ctypes.c_void_p]
+        lib.lh_cells_size.restype = ctypes.c_int64
+        lib.lh_cells_size.argtypes = [ctypes.c_void_p]
+        lib.lh_cells_add.restype = ctypes.c_int64
+        lib.lh_cells_add.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.lh_cells_drain.restype = ctypes.c_int64
+        lib.lh_cells_drain.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
         ]
         _lib = lib
         return _lib
@@ -216,29 +225,19 @@ def preaggregate(
     ids: np.ndarray, values: np.ndarray, bucket_limit: int,
     precision: int = 100,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Compress + dedup one batch into unique (id, codec_bucket, count)
-    cells — the host-side transport compressor for H2D ingest.  Returns
+    """One-shot compress + dedup of a batch into unique (id, codec_bucket,
+    count) cells.  A thin convenience over CellStore (one implementation
+    of the codec/dedup contract, not two).  Returns
     (ids int32[m], codec_buckets int32[m], counts int64[m])."""
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native library unavailable: {_build_error}")
-    ids = np.ascontiguousarray(ids, dtype=np.int32)
-    values = np.ascontiguousarray(values, dtype=np.float32)
-    if ids.shape != values.shape:
-        raise ValueError("ids and values must have the same shape")
-    n = len(ids)
-    ids_out = np.empty(n, dtype=np.int32)
-    buckets_out = np.empty(n, dtype=np.int32)
-    counts_out = np.empty(n, dtype=np.int64)
-    m = lib.lh_preaggregate(
-        _i32(ids), values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        n, precision, bucket_limit, _i32(ids_out), _i32(buckets_out),
-        counts_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-    )
-    if m < 0:
-        raise MemoryError("lh_preaggregate allocation failed")
-    return (ids_out[:m].copy(), buckets_out[:m].copy(),
-            counts_out[:m].copy())
+    store = CellStore(bucket_limit, precision,
+                      initial_capacity=max(1024, 2 * len(ids)))
+    try:
+        consumed = store.add(ids, values)
+        if consumed < len(ids):
+            raise MemoryError("cell table allocation failed")
+        return store.drain()
+    finally:
+        store.close()
 
 
 def accumulate_dense(
@@ -259,6 +258,70 @@ def accumulate_dense(
         acc.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), num_metrics,
     )
     return acc
+
+
+class CellStore:
+    """Persistent (id, codec_bucket) -> count host accumulator.
+
+    Batches fold in across flushes (`add`); `drain` empties it into
+    unique-cell arrays for one weighted device merge.  This decouples
+    sample rate from host->device wire bandwidth: the wire cost is the
+    interval's unique cells, however many samples they absorbed."""
+
+    def __init__(self, bucket_limit: int, precision: int = 100,
+                 initial_capacity: int = 1 << 16):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native library unavailable: {_build_error}")
+        self._lib = lib
+        self._handle = lib.lh_cells_create(initial_capacity)
+        if not self._handle:
+            raise MemoryError("lh_cells_create failed")
+        self.bucket_limit = bucket_limit
+        self.precision = precision
+
+    def __len__(self) -> int:
+        return int(self._lib.lh_cells_size(self._handle))
+
+    def add(self, ids: np.ndarray, values: np.ndarray) -> int:
+        """Fold a batch in.  Returns the number of samples CONSUMED from
+        the front of the batch: len(ids) on success, fewer only when the
+        table could not grow — the consumed prefix is folded exactly
+        once, so the caller retries ids[consumed:] (typically after
+        draining).  Negative ids are consumed but skipped."""
+        ids = np.ascontiguousarray(ids, dtype=np.int32)
+        values = np.ascontiguousarray(values, dtype=np.float32)
+        if ids.shape != values.shape:
+            raise ValueError("ids and values must have the same shape")
+        consumed = self._lib.lh_cells_add(
+            self._handle, _i32(ids),
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            len(ids), self.precision, self.bucket_limit,
+        )
+        return int(consumed)
+
+    def drain(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Empty the store; returns (ids, codec_buckets, counts)."""
+        m = len(self)
+        ids_out = np.empty(m, dtype=np.int32)
+        buckets_out = np.empty(m, dtype=np.int32)
+        counts_out = np.empty(m, dtype=np.int64)
+        got = self._lib.lh_cells_drain(
+            self._handle, _i32(ids_out), _i32(buckets_out),
+            counts_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        return ids_out[:got], buckets_out[:got], counts_out[:got]
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.lh_cells_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class NativeIngestBuffer:

@@ -141,65 +141,136 @@ void lh_decompress(const int16_t* buckets, int64_t n, int precision,
   }
 }
 
-// Host-side pre-aggregation: compress + dedup one (ids, values) batch into
-// unique (id, codec_bucket) cells with int64 counts, via an open-addressing
-// hash table.  This is the transport compressor for host->device ingest:
-// a Zipf batch of millions of samples collapses to a few thousand cells,
-// so the wire carries O(unique cells) instead of O(samples) — the same
-// local-aggregate-before-network shape as the multi-host psum design.
-// Negative ids (registry-shed samples) are skipped.  Returns the number
-// of unique cells written (<= n), or -1 on allocation failure.
-int64_t lh_preaggregate(const int32_t* ids, const float* values, int64_t n,
-                        int precision, int bucket_limit, int32_t* ids_out,
-                        int32_t* buckets_out, int64_t* counts_out) {
-  if (n <= 0) return 0;
-  struct Slot {
-    uint64_t key;
-    int64_t count;
-  };
-  uint64_t cap = 1;
-  while (cap < static_cast<uint64_t>(n) * 2) cap <<= 1;
-  std::vector<Slot> table;
-  try {
-    table.assign(cap, Slot{0, 0});
-  } catch (...) {
-    return -1;
+}  // extern "C"
+
+// Persistent host cell store: an open-addressing (id, codec_bucket) ->
+// int64 count table that ACCUMULATES across flushes, so one device ship
+// per interval carries the dedup of the whole interval, not one batch.
+// This is the host-tier half of interval-granularity transport: sample
+// rate is decoupled from wire bandwidth (wire cost = unique cells per
+// interval), which is what lets a thin host->device link keep up with
+// a firehose of samples.
+
+namespace {
+
+struct CellSlot {
+  uint64_t key;  // (id << 16) | (bucket + 32768); 0 = empty
+  int64_t count;
+};
+
+struct CellStore {
+  std::vector<CellSlot> table;
+  uint64_t mask;
+  int64_t used = 0;
+
+  explicit CellStore(uint64_t cap) : table(cap, CellSlot{0, 0}), mask(cap - 1) {}
+
+  bool grow() {
+    uint64_t new_cap = table.size() * 2;
+    std::vector<CellSlot> fresh;
+    try {
+      fresh.assign(new_cap, CellSlot{0, 0});
+    } catch (...) {
+      return false;
+    }
+    uint64_t new_mask = new_cap - 1;
+    for (const CellSlot& s : table) {
+      if (s.key == 0) continue;
+      uint64_t h = s.key * 0x9E3779B97F4A7C15ull;
+      uint64_t j = (h ^ (h >> 32)) & new_mask;
+      while (fresh[j].key != 0) j = (j + 1) & new_mask;
+      fresh[j] = s;
+    }
+    table.swap(fresh);
+    mask = new_mask;
+    return true;
   }
-  const uint64_t mask = cap - 1;
+
+  bool add_one(uint64_t key, int64_t weight) {
+    uint64_t h = key * 0x9E3779B97F4A7C15ull;
+    uint64_t j = (h ^ (h >> 32)) & mask;
+    while (true) {
+      if (table[j].key == key) {
+        table[j].count += weight;
+        return true;
+      }
+      if (table[j].key == 0) {
+        // keep load factor under ~0.7 so probe chains stay short
+        if ((used + 1) * 10 >= static_cast<int64_t>(table.size()) * 7) {
+          if (!grow()) return false;
+          return add_one(key, weight);
+        }
+        table[j].key = key;
+        table[j].count = weight;
+        ++used;
+        return true;
+      }
+      j = (j + 1) & mask;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* lh_cells_create(int64_t initial_capacity) {
+  uint64_t cap = 1024;
+  while (cap < static_cast<uint64_t>(initial_capacity)) cap <<= 1;
+  try {
+    // nothrow covers only the object shell; the constructor's vector
+    // fill can itself throw, and an exception must never cross the C ABI
+    return new (std::nothrow) CellStore(cap);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+void lh_cells_destroy(void* store) { delete static_cast<CellStore*>(store); }
+
+int64_t lh_cells_size(void* store) {
+  return static_cast<CellStore*>(store)->used;
+}
+
+// Fold one batch into the store. Returns the number of samples CONSUMED
+// from the input (including skipped negative ids): n on full success,
+// or i < n if a table growth allocation failed before sample i — the
+// prefix [0, i) is already folded, so the caller retries only ids[i:]
+// (typically after draining).  This exactness contract is what lets the
+// Python layer recover from allocation failure without double counting.
+int64_t lh_cells_add(void* store, const int32_t* ids, const float* values,
+                     int64_t n, int precision, int bucket_limit) {
+  CellStore* cs = static_cast<CellStore*>(store);
   for (int64_t i = 0; i < n; ++i) {
     int32_t id = ids[i];
     if (id < 0) continue;
     int32_t b = compress_one(static_cast<double>(values[i]), precision);
     if (b < -bucket_limit) b = -bucket_limit;
     if (b > bucket_limit) b = bucket_limit;
-    // (b + 32768) >= 1 because |b| <= 32767, so key is never the empty
-    // sentinel 0
     uint64_t key =
         (static_cast<uint64_t>(static_cast<uint32_t>(id)) << 16) |
         static_cast<uint16_t>(b + 32768);
-    uint64_t h = key * 0x9E3779B97F4A7C15ull;
-    uint64_t j = (h ^ (h >> 32)) & mask;
-    while (true) {
-      if (table[j].key == key) {
-        ++table[j].count;
-        break;
-      }
-      if (table[j].key == 0) {
-        table[j].key = key;
-        table[j].count = 1;
-        break;
-      }
-      j = (j + 1) & mask;
-    }
+    if (!cs->add_one(key, 1)) return i;
   }
+  return n;
+}
+
+// Copy out every cell and clear the table (capacity retained). Output
+// arrays must hold lh_cells_size entries. Returns the cell count.
+int64_t lh_cells_drain(void* store, int32_t* ids_out, int32_t* buckets_out,
+                       int64_t* counts_out) {
+  CellStore* cs = static_cast<CellStore*>(store);
   int64_t m = 0;
-  for (const Slot& s : table) {
+  for (CellSlot& s : cs->table) {
     if (s.key == 0) continue;
     ids_out[m] = static_cast<int32_t>(s.key >> 16);
     buckets_out[m] = static_cast<int32_t>(s.key & 0xFFFF) - 32768;
     counts_out[m] = s.count;
+    s.key = 0;
+    s.count = 0;
     ++m;
   }
+  cs->used = 0;
   return m;
 }
 

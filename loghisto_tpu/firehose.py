@@ -147,6 +147,7 @@ def run_firehose(
     config: Optional[MetricConfig] = None,
     mesh=None,
     out=sys.stdout,
+    max_inflight: int = 8,
 ) -> dict:
     """Run the firehose; returns a summary dict (samples/s, intervals).
     With `mesh`, generation+aggregation run SPMD with psum merges."""
@@ -190,9 +191,20 @@ def run_firehose(
     while time.perf_counter() - t_start < seconds:
         t_int = time.perf_counter()
         interval_samples = 0
+        inflight = 0
         while time.perf_counter() - t_int < interval:
             acc, key = step(acc, key)
             interval_samples += batch
+            # bound the async dispatch queue: without this, a dispatcher
+            # that runs ahead of the device (or of a slow link) enqueues
+            # thousands of steps inside one wall-clock interval and the
+            # stats sync below then drains them for minutes — the
+            # interval's sample count must reflect work the device kept
+            # up with, not a backlog
+            inflight += 1
+            if inflight >= max_inflight:
+                jax.block_until_ready(acc)
+                inflight = 0
         stats = stats_fn(acc, ps)
         counts = np.asarray(stats["counts"])
         pcts = np.asarray(stats["percentiles"])

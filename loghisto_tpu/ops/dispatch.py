@@ -1,24 +1,41 @@
 """Automatic ingest-path selection (VERDICT r1 item 6).
 
-Three bit-identical device accumulation kernels exist (scatter / one-hot
-MXU matmul / metric-tiled Pallas multirow); they differ only in speed per
-(num_metrics, num_buckets, platform) configuration.  The crossover rule in
-ops/matmul_hist.py ("use when num_metrics*num_buckets <= ~2^21") is made
-real here: ``TPUAggregator(ingest_path="auto")`` — the default — calls
+Five bit-identical device accumulation kernels exist (scatter / sort-dedup
+scatter / one-hot MXU matmul / Pallas row / Pallas multirow); they differ
+only in speed per (num_metrics, num_buckets, platform) configuration.
+``TPUAggregator(ingest_path="auto")`` — the default — calls
 ``choose_ingest_path`` at construction (platform is known then; this is
 NOT a trace-time probe).
 
-Thresholds are provisional pending the real-TPU measurement table from
-benchmarks/device_paths.py (benchmarks/tpu_watch.sh captures it); refresh
-the constants below when BENCH_r02 lands.  On CPU the scatter path wins
-everywhere measured (BENCH_r01 table), so auto == scatter there.
+Thresholds come from the real-TPU measurement table captured in
+TPU_CAPTURE_r2/device_paths.json (benchmarks/device_paths.py on a
+v5 lite chip, batch 2^22, 8193 buckets):
+
+    M=1:      pallas 8.2M/s > sort 6.7M > matmul 4.3M > scatter 3.4M
+    M=16:     scatter 5.8M > multirow 5.0M > matmul 4.1M > sort 3.4M
+    M=256:    scatter 4.8M > matmul 4.7M > sort 4.0M > multirow 3.6M
+    M=10000:  sort 3.4M > scatter 2.5M > multirow 2.3M
+
+(Absolute rates in that capture are tunnel-latency-skewed; the
+within-row ranking is the signal.)  Duplicate-heavy scatters serialize
+on TPU, which is why sort-dedup wins back the lead at high metric
+cardinality where Zipf batches concentrate on hot rows, and why the
+fused Pallas row kernel wins the single-metric case outright.  On CPU
+the scatter path wins everywhere measured (BENCH_r01 table), so auto ==
+scatter there.
 """
 
 from __future__ import annotations
 
-# Dense one-hot matmul materializes an [N, B] one-hot per tile; profitable
-# only while the whole [M, B] accumulator is MXU-tile sized.  Above this
-# the scatter path wins (and is the only mesh-shardable formulation).
+# Measured crossover (device_paths.json): sort-dedup overtakes plain
+# scatter between M=256 and M=10000; the conservative switch point keeps
+# scatter through the mid range it dominates.
+SORT_MIN_METRICS = 4096
+
+# Dense one-hot matmul materializes an [N, B] one-hot per tile; the r2
+# table shows it never beating scatter on hardware at >=16 metrics, and
+# losing to the Pallas row kernel at M=1 — it remains available for
+# explicit selection but auto no longer picks it.
 MATMUL_MAX_CELLS = 1 << 21
 
 
@@ -27,10 +44,14 @@ def choose_ingest_path(
 ) -> str:
     """Pick the measured-fastest ingest kernel for a configuration.
 
-    The Pallas multirow kernel stays opt-in until hardware validation
-    (benchmarks/pallas_parity.py) demotes or promotes it — "auto" never
-    selects an unproven kernel.
+    The Pallas multirow kernel stays opt-in: hardware-validated for
+    parity (TPU_CAPTURE_r2/pallas_parity.json) but never the fastest at
+    any measured config, so "auto" does not select it.  The Pallas row
+    kernel wins M=1 but has a different call signature (no ids); the
+    aggregator's batch interface needs the (ids, values) forms, so auto
+    picks sort/scatter and PrintBenchmark-style single-metric users reach
+    the row kernel via ops.pallas_kernels directly.
     """
-    if platform == "tpu" and num_metrics * num_buckets <= MATMUL_MAX_CELLS:
-        return "matmul"
+    if platform == "tpu" and num_metrics >= SORT_MIN_METRICS:
+        return "sort"
     return "scatter"

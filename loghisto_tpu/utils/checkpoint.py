@@ -63,7 +63,11 @@ def save(
             )
 
     if aggregator is not None:
-        aggregator.flush()
+        # force: the preagg transport holds cells in a host store between
+        # interval boundaries, and a cooling-down device gates non-forced
+        # raw flushes — either way a plain flush() could silently omit
+        # staged samples from the snapshot
+        aggregator.flush(force=True)
         with aggregator._dev_lock:
             # canonical dense layout: snapshots stay portable across
             # ingest_path choices (multirow's lane padding is stripped)

@@ -334,3 +334,46 @@ def test_partial_merge_failure_never_double_counts(monkeypatch):
     total = sum(v for k, v in out.items()
                 if k.endswith("_count") and not k.endswith("_agg_count"))
     assert total == 12, total
+
+
+def test_preagg_cells_persist_until_interval_boundary():
+    """Non-forced flushes fold into the host cell store (no device
+    traffic); collect() ships and reports everything exactly."""
+    from loghisto_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native library unavailable")
+    agg = TPUAggregator(
+        num_metrics=8, config=CFG, transport="preagg", batch_size=128,
+    )
+    agg.registry.id_for("m")
+    before = np.asarray(agg._acc).sum()
+    for _ in range(5):  # crosses batch_size -> auto non-forced flushes
+        agg.record_batch(
+            np.zeros(100, dtype=np.int32),
+            np.full(100, 3.0, dtype=np.float32),
+        )
+    assert len(agg._cell_store) >= 1
+    assert np.asarray(agg._acc).sum() == before  # device untouched
+    out = agg.collect().metrics
+    assert out["m_count"] == 500
+    assert len(agg._cell_store) == 0
+
+
+def test_preagg_watermark_ships_mid_interval():
+    from loghisto_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native library unavailable")
+    agg = TPUAggregator(
+        num_metrics=8, config=CFG, transport="preagg", batch_size=64,
+    )
+    agg.max_host_cells = 16
+    agg.registry.id_for("m")
+    # 64 distinct values -> >16 unique cells; crossing batch_size flushes,
+    # and the watermark forces a device ship despite force=False
+    vals = (np.arange(64) * 7 + 1).astype(np.float32)
+    agg.record_batch(np.zeros(64, dtype=np.int32), vals)
+    assert len(agg._cell_store) == 0  # shipped
+    assert np.asarray(agg._acc).sum() == 64
+    assert agg.collect().metrics["m_count"] == 64

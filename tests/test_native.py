@@ -143,3 +143,32 @@ def test_native_preaggregate_nan_matches_device_contract():
     assert uids.tolist() == [0]
     assert ubuckets.tolist() == [0]
     assert uweights.tolist() == [3]
+
+
+def test_cell_store_accumulates_across_adds_and_drains():
+    store = _native.CellStore(bucket_limit=512)
+    ids = np.array([0, 0, 1], dtype=np.int32)
+    vals = np.array([10.0, 10.0, 10.0], dtype=np.float32)
+    assert store.add(ids, vals) == 3
+    assert store.add(ids, vals) == 3  # same cells, counts accumulate
+    assert len(store) == 2
+    uids, ubkts, uwts = store.drain()
+    got = dict(zip(zip(uids.tolist(), ubkts.tolist()), uwts.tolist()))
+    b = int(compress_np(np.array([10.0]))[0])
+    assert got == {(0, b): 4, (1, b): 2}
+    assert len(store) == 0
+    uids2, _, _ = store.drain()
+    assert len(uids2) == 0
+    store.close()
+
+
+def test_cell_store_growth_past_initial_capacity():
+    store = _native.CellStore(bucket_limit=8192, initial_capacity=1024)
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 5000, 100_000).astype(np.int32)
+    vals = rng.lognormal(8, 3, 100_000).astype(np.float32)
+    assert store.add(ids, vals) == 100_000
+    uids, ubkts, uwts = store.drain()
+    assert int(uwts.sum()) == 100_000
+    assert len(uids) > 1024  # grew well past the initial table
+    store.close()
