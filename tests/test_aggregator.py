@@ -377,3 +377,33 @@ def test_preagg_watermark_ships_mid_interval():
     assert len(agg._cell_store) == 0  # shipped
     assert np.asarray(agg._acc).sum() == 64
     assert agg.collect().metrics["m_count"] == 64
+
+
+def test_preagg_transport_with_mesh_matches_single_device():
+    """The cell-store transport must compose with the sharded accumulator:
+    the weighted merge runs SPMD and the result matches single-device."""
+    import jax
+
+    from loghisto_tpu import _native
+    from loghisto_tpu.parallel.mesh import make_mesh
+
+    if not _native.available():
+        pytest.skip("native library unavailable")
+    mesh = make_mesh(stream=4, metric=2, devices=jax.devices()[:8])
+    rng = np.random.default_rng(11)
+    ids = rng.integers(0, 8, 30_000).astype(np.int32)
+    values = rng.lognormal(1, 0.8, 30_000).astype(np.float32)
+
+    single = TPUAggregator(num_metrics=8, config=CFG, transport="preagg")
+    sharded = TPUAggregator(
+        num_metrics=8, config=CFG, mesh=mesh, transport="preagg"
+    )
+    for agg in (single, sharded):
+        for i in range(8):
+            agg.registry.id_for(f"m{i}")
+        agg.record_batch(ids, values)
+    want = single.collect().metrics
+    got = sharded.collect().metrics
+    assert got.keys() == want.keys()
+    for key in want:
+        assert got[key] == pytest.approx(want[key], rel=1e-6), key
