@@ -25,6 +25,60 @@ class ChannelClosed(Exception):
     """Raised by get() on a closed, drained channel."""
 
 
+class ResilientSubscription:
+    """A subscription that survives strike-eviction.
+
+    The reference's contract closes a slow subscriber's channel and
+    forgets it (metrics.go:565-581) — correct shedding for arbitrary
+    user channels, but a long-lived infrastructure consumer (exporter,
+    journal) that dies permanently because of one transient stall is an
+    operational hazard.  This wrapper's ``get`` transparently
+    re-subscribes on a fresh channel after an eviction (the stalled
+    intervals stay dropped — shed-don't-block is preserved) unless
+    ``close`` was called, in which case ChannelClosed propagates.
+    ``evictions`` counts occurrences for observability."""
+
+    def __init__(self, subscribe, unsubscribe, capacity: int):
+        self._subscribe = subscribe
+        self._unsubscribe = unsubscribe
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._stopped = False
+        self.evictions = 0
+        ch = Channel(capacity)
+        subscribe(ch)
+        self._ch = ch
+
+    def get(self, block: bool = True, timeout: float | None = None) -> Any:
+        """Like Channel.get, but an eviction re-subscribes and retries.
+        Raises ChannelClosed only after close(); queue.Empty on timeout."""
+        while True:
+            with self._lock:
+                ch = self._ch
+            try:
+                return ch.get(block=block, timeout=timeout)
+            except ChannelClosed:
+                with self._lock:
+                    if self._stopped:
+                        raise
+                    if self._ch is ch:  # first getter to notice re-subs
+                        self.evictions += 1
+                        fresh = Channel(self.capacity)
+                        self._subscribe(fresh)
+                        self._ch = fresh
+
+    def close(self) -> None:
+        """Unsubscribe and close; get() raises ChannelClosed afterwards.
+        Idempotent."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            ch = self._ch
+        self._unsubscribe(ch)
+        ch.close()
+
+
 class Channel:
     _SENTINEL = object()
 

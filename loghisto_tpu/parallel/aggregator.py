@@ -1148,16 +1148,22 @@ class TPUAggregator:
                 agg_view = {
                     mid: list(entry) for mid, entry in self._agg.items()
                 }
-            for mid, name in enumerate(names):
+            # Fold EVERY nonzero row into the lifetime store, named or
+            # not: record_batch with raw unregistered ids is a supported
+            # pattern (checkpoints identity-map such rows), so a reset
+            # must not discard their history — it surfaces as soon as
+            # the row's name is registered.  Reporting stays name-gated.
+            for mid in np.nonzero(counts)[0]:
+                mid = int(mid)
                 count = int(counts[mid])
-                if count == 0:
-                    continue
                 total = float(sums[mid])
-                metrics[f"{name}_count"] = float(count)
-                metrics[f"{name}_sum"] = total
-                metrics[f"{name}_avg"] = total / count
-                for label, value in zip(labels, pcts[mid]):
-                    metrics[label % name] = float(value)
+                if mid < len(names):
+                    name = names[mid]
+                    metrics[f"{name}_count"] = float(count)
+                    metrics[f"{name}_sum"] = total
+                    metrics[f"{name}_avg"] = total / count
+                    for label, value in zip(labels, pcts[mid]):
+                        metrics[label % name] = float(value)
                 # int seed: go_compat accumulates exact integers like the
                 # reference's uint64 store; float mode promotes naturally.
                 entry = agg_view.setdefault(mid, [0, 0])

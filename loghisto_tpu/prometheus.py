@@ -21,7 +21,7 @@ import re
 import threading
 from typing import Optional
 
-from loghisto_tpu.channel import Channel, ChannelClosed
+from loghisto_tpu.channel import ChannelClosed, ResilientSubscription
 from loghisto_tpu.metrics import MetricSystem, ProcessedMetricSet
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
@@ -90,7 +90,7 @@ class PrometheusEndpoint:
     ):
         self._ms = metric_system
         self._addr = (host, port)
-        self._ch: Optional[Channel] = None
+        self._sub: Optional[ResilientSubscription] = None
         self._latest: bytes = b"# no interval collected yet\n"
         self._latest_lock = threading.Lock()
         self._server: Optional[http.server.ThreadingHTTPServer] = None
@@ -128,15 +128,22 @@ class PrometheusEndpoint:
 
         self._server = http.server.ThreadingHTTPServer(self._addr, Handler)
         self._server.daemon_threads = True
-        self._ch = Channel(8)
-        self._ms.subscribe_to_processed_metrics(self._ch)
+        # survives strike-eviction (a starved updater whose channel the
+        # reaper closes re-subscribes instead of serving stale data
+        # forever) — shared recovery contract with Submitter/Journal
+        self._sub = ResilientSubscription(
+            self._ms.subscribe_to_processed_metrics,
+            self._ms.unsubscribe_from_processed_metrics,
+            8,
+        )
+        sub = self._sub
 
         def updater():
             while True:
                 try:
-                    pms = self._ch.get()
+                    pms = sub.get()
                 except ChannelClosed:
-                    return
+                    return  # stop() closed the subscription
                 payload = prometheus_exposition(pms)
                 with self._latest_lock:
                     self._latest = payload
@@ -158,10 +165,9 @@ class PrometheusEndpoint:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
-        if self._ch is not None:
-            self._ms.unsubscribe_from_processed_metrics(self._ch)
-            self._ch.close()
-            self._ch = None
+        if self._sub is not None:
+            self._sub.close()
+            self._sub = None
         for t in self._threads:
             t.join(timeout=5.0)
         self._threads = []

@@ -24,7 +24,7 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
-from loghisto_tpu.channel import Channel, ChannelClosed
+from loghisto_tpu.channel import ChannelClosed, ResilientSubscription
 from loghisto_tpu.metrics import MetricSystem, ProcessedMetricSet
 
 logger = logging.getLogger("loghisto_tpu")
@@ -84,10 +84,16 @@ class Submitter:
         self.dial_timeout = dial_timeout
         self._backlog: deque[bytes] = deque(maxlen=backlog_slots)
         self._backlog_lock = threading.Lock()
-        self._metric_chan = Channel(backlog_slots)
+        # survives strike-eviction: one transient stall must not kill the
+        # export path permanently (deliberate improvement over the
+        # reference, whose submitter dies with its evicted channel)
+        self._metric_chan = ResilientSubscription(
+            metric_system.subscribe_to_processed_metrics,
+            metric_system.unsubscribe_from_processed_metrics,
+            backlog_slots,
+        )
         self._shutdown = threading.Event()
         self._threads: list[threading.Thread] = []
-        metric_system.subscribe_to_processed_metrics(self._metric_chan)
 
     # -- backlog ------------------------------------------------------- #
 
@@ -129,7 +135,7 @@ class Submitter:
             try:
                 metrics = self._metric_chan.get(timeout=0.1)
             except ChannelClosed:
-                return  # evicted by the MetricSystem: no more progress
+                return  # shutdown closed the subscription
             except _queue.Empty:
                 continue  # poll timeout; re-check shutdown
             try:
@@ -167,6 +173,7 @@ class Submitter:
     def shutdown(self) -> None:
         """Stop both threads; idempotent (reference submitter.go:152-159)."""
         self._shutdown.set()
+        self._metric_chan.close()
         for t in self._threads:
             if t is not threading.current_thread():
                 t.join(timeout=5.0)

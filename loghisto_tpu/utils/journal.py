@@ -22,7 +22,7 @@ import logging
 import threading
 from typing import Iterator, Optional
 
-from loghisto_tpu.channel import Channel, ChannelClosed
+from loghisto_tpu.channel import ChannelClosed, ResilientSubscription
 from loghisto_tpu.metrics import MetricSystem, RawMetricSet
 
 logger = logging.getLogger("loghisto_tpu")
@@ -108,7 +108,7 @@ class RawJournal:
         self.path = path
         self._ms = metric_system
         self._capacity = channel_capacity
-        self._ch: Optional[Channel] = None
+        self._ch: Optional[ResilientSubscription] = None
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
@@ -127,15 +127,20 @@ class RawJournal:
             f.seek(f.tell() - 1)
             if f.read(1) != "\n":
                 f.write("\n")
-        self._ch = Channel(self._capacity)
-        self._ms.subscribe_to_raw_metrics(self._ch)
+        # survives strike-eviction: a durability journal that dies
+        # permanently after one transient stall defeats its purpose
+        self._ch = ResilientSubscription(
+            self._ms.subscribe_to_raw_metrics,
+            self._ms.unsubscribe_from_raw_metrics,
+            self._capacity,
+        )
         self._thread = threading.Thread(
             target=self._run, args=(f, self._ch), daemon=True,
             name="loghisto-journal",
         )
         self._thread.start()
 
-    def _run(self, f, ch: Channel) -> None:
+    def _run(self, f, ch: ResilientSubscription) -> None:
         with f:
             while True:
                 try:
@@ -150,7 +155,6 @@ class RawJournal:
 
     def stop(self) -> None:
         if self._ch is not None:
-            self._ms.unsubscribe_from_raw_metrics(self._ch)
             self._ch.close()
             self._ch = None
         if self._thread is not None:

@@ -407,3 +407,25 @@ def test_preagg_transport_with_mesh_matches_single_device():
     assert got.keys() == want.keys()
     for key in want:
         assert got[key] == pytest.approx(want[key], rel=1e-6), key
+
+
+def test_unregistered_row_lifetime_survives_reset():
+    """Raw-id ingestion (no registered name) must keep its lifetime
+    aggregates across collect(reset=True); the history surfaces once the
+    row's name is registered (matching checkpoint identity mapping)."""
+    agg = TPUAggregator(num_metrics=4, config=CFG)
+    agg.record_batch(
+        np.full(10, 2, dtype=np.int32),  # row 2, never registered
+        np.full(10, 5.0, dtype=np.float32),
+    )
+    first = agg.collect().metrics   # nothing namable this interval
+    assert not any(k.endswith("_agg_count") for k in first)
+    agg.registry.id_for("a")  # rows 0,1 -> names a,b; row 2 -> c
+    agg.registry.id_for("b")
+    agg.registry.id_for("c")
+    agg.record_batch(
+        np.full(3, 2, dtype=np.int32), np.full(3, 5.0, dtype=np.float32)
+    )
+    out = agg.collect().metrics
+    assert out["c_count"] == 3
+    assert out["c_agg_count"] == 13  # 10 pre-registration + 3 after

@@ -109,3 +109,56 @@ def test_producer_consumer_threaded():
     ch.close()
     t.join(timeout=5)
     assert received == list(range(1000))
+
+
+def test_resilient_subscription_survives_eviction():
+    from loghisto_tpu.channel import ResilientSubscription
+
+    subscribed = []
+
+    def subscribe(ch):
+        subscribed.append(ch)
+
+    def unsubscribe(ch):
+        subscribed.remove(ch)
+
+    sub = ResilientSubscription(subscribe, unsubscribe, capacity=4)
+    assert len(subscribed) == 1
+    subscribed[0].offer("a")
+    assert sub.get() == "a"
+    subscribed[0].close()  # producer evicts us
+    import threading
+    import time
+
+    got = []
+    t = threading.Thread(target=lambda: got.append(sub.get()))
+    t.start()
+    # wait (bounded) until the fresh channel is subscribed, then feed it
+    deadline = time.time() + 5
+    fresh = None
+    while time.time() < deadline:
+        fresh = subscribed[-1] if subscribed else None
+        if fresh is not None and not fresh.closed:
+            break
+        time.sleep(0.01)
+    assert fresh is not None and not fresh.closed, "never re-subscribed"
+    fresh.offer("b")
+    t.join(timeout=5)
+    assert got == ["b"]
+    assert sub.evictions == 1
+    sub.close()
+    # the producer forgot the evicted channel itself when it closed it
+    # (this mock doesn't simulate that); close() must unsubscribe the
+    # CURRENT channel
+    assert fresh not in subscribed
+
+
+def test_resilient_subscription_close_raises_channelclosed():
+    from loghisto_tpu.channel import Channel, ChannelClosed
+    from loghisto_tpu.channel import ResilientSubscription
+
+    sub = ResilientSubscription(lambda ch: None, lambda ch: None, 2)
+    sub.close()
+    with pytest.raises(ChannelClosed):
+        sub.get()
+    sub.close()  # idempotent

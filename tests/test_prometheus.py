@@ -99,3 +99,35 @@ def test_endpoint_stop_idempotent():
     ep.start()
     ep.stop()
     ep.stop()
+
+
+def test_endpoint_resubscribes_after_eviction():
+    """A starved updater whose channel the reaper strike-evicts must
+    re-subscribe and resume serving fresh intervals, not stay stale."""
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    ep = PrometheusEndpoint(ms, port=0, host="127.0.0.1")
+    ep.start()
+    try:
+        evicted = ep._sub._ch
+        evicted.close()  # what the reaper's eviction does
+        deadline = time.time() + 10
+        while time.time() < deadline and ep._sub._ch is evicted:
+            time.sleep(0.02)
+        assert ep._sub._ch is not evicted
+        assert ep._sub.evictions == 1
+        ms.counter("after", 5)
+        ms.start()
+        deadline = time.time() + 10
+        body = ""
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{ep.port}/metrics", timeout=2
+            ) as resp:
+                body = resp.read().decode()
+            if "after 5.0" in body:
+                break
+            time.sleep(0.05)
+        assert "after 5.0" in body
+    finally:
+        ep.stop()
+        ms.stop()
