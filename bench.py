@@ -33,6 +33,103 @@ STEPS = 16
 # One full statistics extraction per simulated interval; 16 batches
 # (~67M samples) per interval approximates a 1s interval at TPU rates.
 STATS_EVERY = 16
+# Looped-interval mode (TPU): ROUNDS passes over DISTINCT_BATCHES
+# pre-staged batches inside ONE jit dispatch, stats once at the end.
+# Distinct batches stop XLA hoisting the compress as loop-invariant;
+# the big loop makes device time dominate dispatch latency, so the
+# reported rate no longer swings orders of magnitude with tunnel health
+# (per-dispatch measurements of this same workload ranged 20G-153G/s
+# across three capture windows).
+DISTINCT_BATCHES = 8
+ROUNDS = 128  # 8 x 128 x 4.2M = 4.3G samples per timed dispatch
+
+
+def measure_headline(jax, jnp, cfg, ps, rounds: int | None = None) -> dict:
+    """Device-resident headline: samples/s + stats-query latency."""
+    import jax.numpy  # noqa: F401 (jnp passed in)
+
+    from loghisto_tpu.ops.ingest import ingest_batch
+    from loghisto_tpu.ops.stats import dense_stats
+
+    # rounds=None -> adaptive: probe with one round, then size the real
+    # measurement to ~20s of device time (capped at ROUNDS), so a slow
+    # kernel (the serialized scatter runs ~9M/s at 10k metrics) cannot
+    # make one dispatch outlive the 420s watchdog
+
+    rng = np.random.default_rng(0)
+    ids8 = jax.device_put(np.stack([
+        zipf_ids(rng, BATCH, NUM_METRICS) for _ in range(DISTINCT_BATCHES)
+    ]))
+    values8 = jax.device_put(np.stack([
+        rng.lognormal(10.0, 2.0, BATCH).astype(np.float32)
+        for _ in range(DISTINCT_BATCHES)
+    ]))
+
+    stats = jax.jit(
+        lambda acc: dense_stats(acc, ps, cfg.bucket_limit, cfg.precision)
+    )
+
+    def make_interval(n_rounds):
+        @jax.jit
+        def interval(acc, ids8, values8):
+            def body(i, a):
+                ids = jax.lax.dynamic_index_in_dim(
+                    ids8, i % DISTINCT_BATCHES, keepdims=False
+                )
+                values = jax.lax.dynamic_index_in_dim(
+                    values8, i % DISTINCT_BATCHES, keepdims=False
+                )
+                return ingest_batch(a, ids, values, cfg.bucket_limit,
+                                    cfg.precision)
+            acc = jax.lax.fori_loop(
+                0, DISTINCT_BATCHES * n_rounds, body, acc
+            )
+            return acc, dense_stats(acc, ps, cfg.bucket_limit,
+                                    cfg.precision)
+        return interval
+
+    # Timing MUST end at a host-side VALUE fetched from the result, not
+    # at block_until_ready: a tunneled/asynchronous PJRT backend can ack
+    # dispatches (and readiness) before device execution finishes —
+    # block-based timing measured a physically impossible 31T samples/s
+    # (4.3G samples in 0.1ms) on the r2e capture.  Fetching the stats
+    # counts (40KB) cannot complete before the work that produced them.
+    def timed(n_rounds, acc):
+        fn = make_interval(n_rounds)
+        acc, s = fn(acc, ids8, values8)  # compile + warm
+        np.asarray(s["counts"])
+        t0 = time.perf_counter()
+        acc, s = fn(acc, ids8, values8)
+        counts_host = np.asarray(s["counts"])
+        elapsed = time.perf_counter() - t0
+        assert counts_host.sum() > 0
+        return elapsed, acc
+
+    acc = jnp.zeros((NUM_METRICS, cfg.num_buckets), dtype=jnp.int32)
+    if rounds is None:
+        probe_elapsed, acc = timed(1, acc)
+        per_round = probe_elapsed  # upper bound (includes latency)
+        rounds = max(1, min(ROUNDS, int(20.0 / per_round)))
+    if rounds > 1:
+        elapsed, acc = timed(rounds, acc)
+    else:
+        elapsed, acc = timed(1, acc)
+        rounds = 1
+    samples = DISTINCT_BATCHES * rounds * BATCH
+    samples_per_s = samples / elapsed
+
+    lat = []
+    for _ in range(20):
+        t1 = time.perf_counter()
+        np.asarray(stats(acc)["counts"])  # value fetch, same reason
+        lat.append(time.perf_counter() - t1)
+    return {
+        "samples_per_s": samples_per_s,
+        "elapsed_s": elapsed,
+        "samples": samples,
+        "percentile_query_p99_us": float(np.percentile(lat, 99) * 1e6),
+        "percentile_query_median_us": float(np.median(lat) * 1e6),
+    }
 
 
 def zipf_ids(rng: np.random.Generator, n: int, m: int) -> np.ndarray:
@@ -128,8 +225,6 @@ def main() -> None:
     import jax.numpy as jnp
 
     from loghisto_tpu.config import MetricConfig
-    from loghisto_tpu.ops.ingest import make_ingest_fn
-    from loghisto_tpu.ops.stats import dense_stats
 
     cfg = MetricConfig(bucket_limit=BUCKET_LIMIT)
     ps = np.array(
@@ -140,54 +235,23 @@ def main() -> None:
     dev = jax.devices()[0]
     platform = dev.platform
 
-    # donated accumulator: steady-state ingest is allocation-free
-    ingest = make_ingest_fn(cfg.bucket_limit, cfg.precision)
-
-    @jax.jit
-    def stats(acc):
-        return dense_stats(acc, ps, cfg.bucket_limit, cfg.precision)
-
-    rng = np.random.default_rng(0)
-    ids = jax.device_put(zipf_ids(rng, BATCH, NUM_METRICS))
-    values = jax.device_put(
-        rng.lognormal(mean=10.0, sigma=2.0, size=BATCH).astype(np.float32)
-    )
-    acc = jnp.zeros((NUM_METRICS, cfg.num_buckets), dtype=jnp.int32)
-
-    # warmup / compile
-    acc = ingest(acc, ids, values)
-    s = stats(acc)
-    jax.block_until_ready((acc, s))
-    ready.set()  # device is alive and compiled; disarm the watchdog
-
-    # timed ingest steps with periodic stats extraction
-    t0 = time.perf_counter()
-    for i in range(STEPS):
-        acc = ingest(acc, ids, values)
-        if (i + 1) % STATS_EVERY == 0:
-            s = stats(acc)
-    jax.block_until_ready((acc, s))
-    elapsed = time.perf_counter() - t0
-    samples_per_s = BATCH * STEPS / elapsed
-
-    # percentile-query latency: one full stats extraction, steady state
-    lat = []
-    for _ in range(20):
-        t1 = time.perf_counter()
-        jax.block_until_ready(stats(acc))
-        lat.append(time.perf_counter() - t1)
-    p99_query_us = float(np.percentile(lat, 99) * 1e6)
+    head = measure_headline(jax, jnp, cfg, ps)
+    ready.set()  # device is alive and the workload ran; disarm watchdog
+    samples_per_s = head["samples_per_s"]
 
     result = {
         "metric": "histogram samples/sec/chip at 10k metrics",
         "value": round(samples_per_s, 1),
         "unit": "samples/s",
         "vs_baseline": round(samples_per_s / BASELINE_SAMPLES_PER_S, 3),
-        "percentile_query_p99_us": round(p99_query_us, 1),
+        "percentile_query_p99_us": round(head["percentile_query_p99_us"], 1),
+        "percentile_query_median_us": round(
+            head["percentile_query_median_us"], 1
+        ),
         "host_fed_samples_per_s": None,
         "platform": platform,
         "batch": BATCH,
-        "steps": STEPS,
+        "samples_per_interval": head["samples"],
         "num_metrics": NUM_METRICS,
         "num_buckets": cfg.num_buckets,
     }

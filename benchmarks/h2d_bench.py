@@ -48,10 +48,23 @@ def run(num_metrics: int, seconds: float, batch: int,
         values = rng.lognormal(10.0, 2.0, batch).astype(np.float32)
         pool.append((ids, values))
 
+    import jax.numpy as jnp
+
+    def force_value():
+        # a host VALUE fetch, not block_until_ready: an asynchronous
+        # tunnel backend can report readiness before execution finished.
+        # Per-row device reduce (int32-safe: one interval's whole acc
+        # holds < 2^31 samples by the spill guarantee), then an exact
+        # int64 total on host; the wire carries one [M] vector.
+        row_sums = np.asarray(
+            jnp.sum(agg._finalize_acc(agg._acc), axis=1)
+        )
+        return int(row_sums.astype(np.int64).sum())
+
     # warmup: one full flush compiles the ingest executable
     agg.record_batch(*pool[0])
     agg.flush(force=True)
-    jax.block_until_ready(agg._acc)
+    warm_count = force_value()
 
     sent = 0
     t0 = time.perf_counter()
@@ -62,12 +75,13 @@ def run(num_metrics: int, seconds: float, batch: int,
         sent += len(ids)
         i += 1
     agg.flush(force=True)
-    jax.block_until_ready(agg._acc)
+    delivered_device = int(force_value())
     elapsed = time.perf_counter() - t0
     # sustained = samples that actually REACHED the accumulator; counting
     # shed samples would overstate throughput whenever the bounded host
     # buffer dropped under device cooldown
     delivered = sent - agg._shed_samples
+    spilled = int(agg._spill.sum()) if agg._spill is not None else 0
     return {
         "metric": "host-fed samples/sec/chip",
         "value": round(delivered / elapsed, 1),
@@ -78,6 +92,10 @@ def run(num_metrics: int, seconds: float, batch: int,
         "batch": batch,
         "seconds": round(elapsed, 2),
         "shed": agg._shed_samples,
+        # device-side count: cross-checks that `delivered` samples truly
+        # landed in the accumulator (+ any exact host spill; warmup
+        # batch subtracted)
+        "device_count": delivered_device + spilled - warm_count,
     }
 
 

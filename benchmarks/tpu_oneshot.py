@@ -75,11 +75,6 @@ def main() -> int:
     import jax.numpy as jnp
 
     from loghisto_tpu.config import MetricConfig
-    from loghisto_tpu.ops.ingest import make_ingest_fn
-    from loghisto_tpu.ops.stats import dense_stats
-
-    cfg = MetricConfig(bucket_limit=4096)
-    rng = np.random.default_rng(0)
 
     # ---- stage 1: headline bench (same workload as bench.py) ----
     import bench as bench_mod
@@ -89,49 +84,26 @@ def main() -> int:
             [0.0, 0.5, 0.75, 0.9, 0.95, 0.99, 0.999, 0.9999, 1.0],
             dtype=np.float32,
         )
-        BATCH, STEPS, M = bench_mod.BATCH, bench_mod.STEPS, bench_mod.NUM_METRICS
-        ingest = make_ingest_fn(cfg.bucket_limit, cfg.precision)
-        stats = jax.jit(
-            lambda acc: dense_stats(acc, ps, cfg.bucket_limit, cfg.precision)
-        )
-        ids = jax.device_put(bench_mod.zipf_ids(rng, BATCH, M))
-        values = jax.device_put(
-            rng.lognormal(10.0, 2.0, BATCH).astype(np.float32)
-        )
-        acc = jnp.zeros((M, cfg.num_buckets), dtype=jnp.int32)
-        acc = ingest(acc, ids, values)
-        s = stats(acc)
-        jax.block_until_ready((acc, s))
-        t0 = time.perf_counter()
-        for i in range(STEPS):
-            acc = ingest(acc, ids, values)
-            if (i + 1) % bench_mod.STATS_EVERY == 0:
-                s = stats(acc)
-        jax.block_until_ready((acc, s))
-        dt = time.perf_counter() - t0
-        lat = []
-        for _ in range(20):
-            t1 = time.perf_counter()
-            jax.block_until_ready(stats(acc))
-            lat.append(time.perf_counter() - t1)
+        bench_cfg = MetricConfig(bucket_limit=bench_mod.BUCKET_LIMIT)
+        head = bench_mod.measure_headline(jax, jnp, bench_cfg, ps)
+        rate = head["samples_per_s"]
         return {
             "metric": "histogram samples/sec/chip at 10k metrics",
-            "value": round(BATCH * STEPS / dt, 1),
+            "value": round(rate, 1),
             "unit": "samples/s",
-            "vs_baseline": round(
-                BATCH * STEPS / dt / bench_mod.BASELINE_SAMPLES_PER_S, 3
-            ),
+            "vs_baseline": round(rate / bench_mod.BASELINE_SAMPLES_PER_S, 3),
             "percentile_query_p99_us": round(
-                float(np.percentile(lat, 99) * 1e6), 1
+                head["percentile_query_p99_us"], 1
             ),
             "percentile_query_median_us": round(
-                float(np.median(lat) * 1e6), 1
+                head["percentile_query_median_us"], 1
             ),
             "platform": platform,
-            "batch": BATCH,
-            "steps": STEPS,
-            "num_metrics": M,
-            "num_buckets": cfg.num_buckets,
+            "batch": bench_mod.BATCH,
+            "samples_per_interval": head["samples"],
+            "interval_elapsed_s": round(head["elapsed_s"], 3),
+            "num_metrics": bench_mod.NUM_METRICS,
+            "num_buckets": bench_cfg.num_buckets,
         }
 
     stage(outdir, "bench")(headline)
@@ -144,19 +116,6 @@ def main() -> int:
         return {"ok": rc == 0, "exit": rc}
 
     stage(outdir, "pallas_parity")(parity)
-
-    # ---- stage 3: device ingest paths comparison table ----
-    def paths():
-        import benchmarks.device_paths as dp
-
-        argv, sys.argv = sys.argv, ["device_paths.py", "--batch", str(1 << 22),
-                                    "--steps", "8"]
-        try:
-            return dp.main()
-        finally:
-            sys.argv = argv
-
-    stage(outdir, "device_paths")(paths)
 
     # ---- stage 4: host-fed H2D pipeline (VERDICT item 4), both
     # transports: preagg (host compress+dedup, O(cells) wire) vs raw
@@ -185,6 +144,23 @@ def main() -> int:
         return {"ok": True, "note": "output printed to log"}
 
     stage(outdir, "firehose")(firehose)
+
+    # ---- stage 6 (LAST): device ingest path comparison table.  Runs
+    # last because a kernel fault here kills the device for the rest of
+    # the process (the r2d capture lost host_fed + firehose that way);
+    # adaptive looped mode sizes each measurement to ~3s of device time
+    # so rankings measure kernels, not tunnel dispatch latency ----
+    def paths():
+        import benchmarks.device_paths as dp
+
+        argv, sys.argv = sys.argv, ["device_paths.py", "--batch", str(1 << 20),
+                                    "--loop-iters", "8192"]
+        try:
+            return dp.main()
+        finally:
+            sys.argv = argv
+
+    stage(outdir, "device_paths")(paths)
 
     with open(os.path.join(outdir, "SUCCESS"), "w") as f:
         f.write(time.strftime("%Y-%m-%dT%H:%M:%S\n"))

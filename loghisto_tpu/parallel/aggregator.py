@@ -436,6 +436,12 @@ class TPUAggregator:
             self._ingest = make_matmul_ingest_fn(
                 config.bucket_limit, config.precision
             )
+        elif ingest_path == "hybrid":
+            from loghisto_tpu.ops.hybrid_hist import make_hybrid_ingest_fn
+
+            self._ingest = make_hybrid_ingest_fn(
+                config.bucket_limit, config.precision
+            )
         elif ingest_path == "sort":
             from loghisto_tpu.ops.sort_ingest import (
                 make_sort_ingest_fn,
@@ -469,7 +475,7 @@ class TPUAggregator:
         else:
             raise ValueError(
                 f"unknown ingest_path {ingest_path!r}: expected 'auto', "
-                "'scatter', 'matmul', 'sort', or 'multirow'"
+                "'scatter', 'matmul', 'sort', 'hybrid', or 'multirow'"
             )
         self.ingest_path = ingest_path
         self._weighted_ingest = make_weighted_ingest_fn(config.bucket_limit)

@@ -148,7 +148,7 @@ def test_registry_full():
         strict.record("c", 1.0)
 
 
-@pytest.mark.parametrize("path", ["scatter", "matmul", "multirow"])
+@pytest.mark.parametrize("path", ["scatter", "matmul", "hybrid", "multirow"])
 def test_ingest_paths_agree(path):
     agg = TPUAggregator(num_metrics=8, config=CFG, ingest_path=path)
     rng = np.random.default_rng(7)

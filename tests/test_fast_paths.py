@@ -164,3 +164,46 @@ def test_sort_ingest_shape_validated_at_construction():
             num_metrics=1 << 18, config=MetricConfig(bucket_limit=4096),
             ingest_path="sort", max_metrics=1 << 18,
         )
+
+
+def test_hybrid_hist_matches_scatter():
+    """Bit-parity for the hot-head+cold-tail hybrid, incl. edge ids,
+    NaN, negatives, and non-tile-multiple batches."""
+    import numpy as np
+
+    from loghisto_tpu.ops.hybrid_hist import ingest_batch_hybrid
+    from loghisto_tpu.ops.ingest import ingest_batch
+
+    rng = np.random.default_rng(4)
+    m, limit = 512, 512
+    b = 2 * limit + 1
+    raw = rng.zipf(1.3, 30_000)
+    ids = ((raw - 1) % m).astype(np.int32)
+    ids[:8] = [-1, 2**29, m, m - 1, 0, 127, 128, 129]
+    vals = np.concatenate([
+        rng.lognormal(3, 2, 29_997).astype(np.float32),
+        np.array([0.0, np.nan, -7.5], dtype=np.float32),
+    ])
+    want = ingest_batch(jnp.zeros((m, b), jnp.int32), ids, vals, limit)
+    got = ingest_batch_hybrid(jnp.zeros((m, b), jnp.int32), ids, vals,
+                              limit)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+    # accumulate again with a ragged (non-tile-multiple) slice
+    want = ingest_batch(want, ids[:5001], vals[:5001], limit)
+    got = ingest_batch_hybrid(got, ids[:5001], vals[:5001], limit)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_hybrid_rejects_oversized_batch():
+    import numpy as np
+    import pytest as _pytest
+
+    from loghisto_tpu.ops.hybrid_hist import ingest_batch_hybrid
+
+    with _pytest.raises(ValueError, match="2\\^24"):
+        ingest_batch_hybrid(
+            jnp.zeros((4, 1025), jnp.int32),
+            jnp.zeros((1 << 24,), jnp.int32),
+            jnp.zeros((1 << 24,), jnp.float32),
+            512,
+        )
