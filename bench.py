@@ -3,9 +3,10 @@ metrics on one chip (BASELINE.json: "histogram samples/sec/chip at 10k
 metrics; p99 percentile-query latency").
 
 Workload: batches of (metric_id, value) samples, Zipf-skewed across 10k
-metric names (BASELINE.json configs[1]), pushed through the fused
-compress -> scatter-add ingest into the dense int32[10k, 8193] bucket
-tensor, with a full statistics extraction (counts/sums/9 percentiles — the
+metric names (BASELINE.json configs[1]), pushed through the framework's
+default (auto-dispatched) fused compress->accumulate ingest kernel into
+the dense int32[10k, 8193] bucket tensor, with a full statistics
+extraction (counts/sums/9 percentiles — the
 PrintBenchmark percentile set) once per simulated interval.  Batches are
 pre-staged on device: the measured path is the aggregation kernel, the
 host->device transfer story is measured separately by the firehose bench
@@ -44,12 +45,35 @@ DISTINCT_BATCHES = 8
 ROUNDS = 128  # 8 x 128 x 4.2M = 4.3G samples per timed dispatch
 
 
+def _resolve_ingest_step(cfg, platform: str):
+    """The pure per-batch accumulation function the framework would pick
+    by default for this configuration (TPUAggregator(ingest_path="auto")
+    resolves through the same table) — the headline measures what a user
+    of the default path actually gets, not a hardwired kernel.  Override
+    with LOGHISTO_BENCH_PATH=scatter|sort|hybrid for comparisons."""
+    import os
+
+    from loghisto_tpu.ops.dispatch import ingest_step_fn, resolve_ingest_path
+
+    # mirror the default TPUAggregator's resolve call exactly (growth cap
+    # = num_metrics * 8, chunks of batch_size) so the benchmarked kernel
+    # can never drift from the kernel the default-configured product picks
+    path = resolve_ingest_path(
+        os.environ.get("LOGHISTO_BENCH_PATH") or "auto",
+        NUM_METRICS, cfg.num_buckets, platform,
+        guard_metrics=NUM_METRICS * 8, batch_size=BATCH,
+    )
+    return path, ingest_step_fn(path)
+
+
 def measure_headline(jax, jnp, cfg, ps, rounds: int | None = None) -> dict:
     """Device-resident headline: samples/s + stats-query latency."""
     import jax.numpy  # noqa: F401 (jnp passed in)
 
-    from loghisto_tpu.ops.ingest import ingest_batch
     from loghisto_tpu.ops.stats import dense_stats
+
+    platform = jax.devices()[0].platform
+    path, ingest_batch = _resolve_ingest_step(cfg, platform)
 
     # rounds=None -> adaptive: probe with one round, then size the real
     # measurement to ~20s of device time (capped at ROUNDS), so a slow
@@ -127,6 +151,7 @@ def measure_headline(jax, jnp, cfg, ps, rounds: int | None = None) -> dict:
         "samples_per_s": samples_per_s,
         "elapsed_s": elapsed,
         "samples": samples,
+        "ingest_path": path,
         "percentile_query_p99_us": float(np.percentile(lat, 99) * 1e6),
         "percentile_query_median_us": float(np.median(lat) * 1e6),
     }
@@ -249,6 +274,7 @@ def main() -> None:
             head["percentile_query_median_us"], 1
         ),
         "host_fed_samples_per_s": None,
+        "ingest_path": head["ingest_path"],
         "platform": platform,
         "batch": BATCH,
         "samples_per_interval": head["samples"],

@@ -98,6 +98,7 @@ def main() -> int:
             "percentile_query_median_us": round(
                 head["percentile_query_median_us"], 1
             ),
+            "ingest_path": head.get("ingest_path"),
             "platform": platform,
             "batch": bench_mod.BATCH,
             "samples_per_interval": head["samples"],

@@ -64,21 +64,30 @@ def make_firehose_step(
     config: MetricConfig,
     mean: float = 10.0,
     sigma: float = 2.0,
+    ingest_path: str = "auto",
 ):
     """Jitted (acc, key) -> (acc', key'): generate one batch on device and
     accumulate it.  Generation fuses into the ingest program, so HBM
-    traffic is accumulator-only."""
+    traffic is accumulator-only.  The accumulation kernel is the
+    auto-dispatched one for this configuration (sort-dedup at high metric
+    cardinality on TPU — the duplicate-heavy Zipf batches the firehose
+    generates are exactly the regime where plain scatter serializes)."""
     import jax
 
-    from loghisto_tpu.ops.ingest import ingest_batch
+    from loghisto_tpu.ops.dispatch import ingest_step_fn, resolve_ingest_path
 
+    ingest_path = resolve_ingest_path(
+        ingest_path, num_metrics, config.num_buckets,
+        jax.default_backend(), batch_size=batch,
+    )
+    accumulate = ingest_step_fn(ingest_path)
     generate = _make_sample_generator(num_metrics, mean, sigma)
 
     @functools.partial(jax.jit, donate_argnums=0)
     def step(acc, key):
         key, sub = jax.random.split(key)
         ids, values = generate(sub, batch)
-        acc = ingest_batch(
+        acc = accumulate(
             acc, ids, values, config.bucket_limit, config.precision
         )
         return acc, key
@@ -148,6 +157,7 @@ def run_firehose(
     mesh=None,
     out=sys.stdout,
     max_inflight: int = 8,
+    ingest_path: str = "auto",
 ) -> dict:
     """Run the firehose; returns a summary dict (samples/s, intervals).
     With `mesh`, generation+aggregation run SPMD with psum merges."""
@@ -158,9 +168,17 @@ def run_firehose(
 
     config = config or MetricConfig()
     if mesh is not None:
+        if ingest_path != "auto":
+            raise ValueError(
+                "ingest_path is single-device; the mesh firehose always "
+                "uses the shard_map local-fold step (drop ingest_path or "
+                "drop mesh)"
+            )
         step = make_mesh_firehose_step(mesh, num_metrics, batch, config)
     else:
-        step = make_firehose_step(num_metrics, batch, config)
+        step = make_firehose_step(
+            num_metrics, batch, config, ingest_path=ingest_path
+        )
     stats_fn = jax.jit(
         functools.partial(
             dense_stats,

@@ -55,3 +55,74 @@ def choose_ingest_path(
     if platform == "tpu" and num_metrics >= SORT_MIN_METRICS:
         return "sort"
     return "scatter"
+
+
+def resolve_ingest_path(
+    path: str,
+    num_metrics: int,
+    num_buckets: int,
+    platform: str,
+    guard_metrics: int | None = None,
+    batch_size: int | None = None,
+) -> str:
+    """Resolve "auto" and enforce per-path shape preconditions — THE
+    dispatch-guard policy, shared by TPUAggregator, the firehose, and the
+    bench so the benchmarked default can never drift from the product
+    default.  Auto never picks a kernel the shape invalidates (falls back
+    to scatter), while an EXPLICIT choice the shape cannot support raises
+    here — at selection time — instead of silently corrupting histograms
+    inside the traced kernel (the sort and matmul paths' combined int32
+    cell keys wrap negative past 2^31 cells).
+
+    ``guard_metrics`` is the row count to validate shapes against when it
+    exceeds ``num_metrics`` — TPUAggregator passes its growth cap
+    (max_metrics) so auto cannot pick a kernel that registry growth would
+    later invalidate.  ``batch_size``, when known, guards hybrid's
+    float32 hot-head exactness bound (per-batch counts < 2^24)."""
+    from loghisto_tpu.ops.sort_ingest import validate_flat_cell_shape
+
+    guard = max(num_metrics, guard_metrics or 0)
+    if path == "auto":
+        path = choose_ingest_path(num_metrics, num_buckets, platform)
+        if path == "sort":
+            try:
+                validate_flat_cell_shape(guard, num_buckets, "sort")
+            except ValueError:
+                path = "scatter"
+    elif path in ("sort", "matmul"):
+        validate_flat_cell_shape(guard, num_buckets, path)
+    elif path == "hybrid" and batch_size is not None and batch_size >= 1 << 24:
+        raise ValueError(
+            f"hybrid ingest batches must stay < 2^24 samples (float32 "
+            f"hot-head exactness); got batch_size={batch_size}"
+        )
+    return path
+
+
+def ingest_step_fn(path: str):
+    """The pure per-batch accumulation function for a named path, with the
+    uniform ``f(acc, ids, values, bucket_limit, precision) -> acc``
+    contract (scatter / sort / hybrid / matmul — the paths whose dense
+    accumulator layout is interchangeable).  Used wherever a traced step
+    needs the dispatched kernel inline (firehose generation loop, bench
+    interval loop) rather than the TPUAggregator's jitted wrappers."""
+    if path == "sort":
+        from loghisto_tpu.ops.sort_ingest import sort_ingest_batch
+
+        return sort_ingest_batch
+    if path == "hybrid":
+        from loghisto_tpu.ops.hybrid_hist import ingest_batch_hybrid
+
+        return ingest_batch_hybrid
+    if path == "matmul":
+        from loghisto_tpu.ops.matmul_hist import ingest_batch_matmul
+
+        return ingest_batch_matmul
+    if path != "scatter":
+        raise ValueError(
+            f"no pure step form for ingest_path {path!r}: expected "
+            "'scatter', 'sort', 'hybrid', or 'matmul'"
+        )
+    from loghisto_tpu.ops.ingest import ingest_batch
+
+    return ingest_batch

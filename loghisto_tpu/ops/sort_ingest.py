@@ -74,16 +74,28 @@ def sort_ingest_batch(
     )
 
 
-def validate_sort_ingest_shape(num_metrics: int, num_buckets: int) -> None:
-    """Raise if the combined int32 cell key cannot represent this shape.
-    Called at CONSTRUCTION (TPUAggregator) — a raise inside the traced
-    ingest would be swallowed by flush's shed-don't-block failure handling
-    and look like a permanently down device instead of a config error."""
-    if num_metrics * num_buckets >= 2**31 - 2:
+# one shy of the sort path's invalid_key sentinel, which must itself fit
+MAX_FLAT_CELLS = 2**31 - 2
+
+
+def validate_flat_cell_shape(
+    num_metrics: int, num_buckets: int, path: str = "sort"
+) -> None:
+    """Raise if a combined int32 cell key (id * num_buckets + bucket)
+    cannot represent this shape — shared bound for every kernel that
+    flattens (row, bucket) into one int32 (sort's dedup key, matmul's
+    flat cell index).  Called at CONSTRUCTION/selection — a raise inside
+    the traced ingest would be swallowed by flush's shed-don't-block
+    failure handling and look like a permanently down device instead of
+    a config error."""
+    if num_metrics * num_buckets >= MAX_FLAT_CELLS:
         raise ValueError(
-            "sort ingest needs num_metrics * num_buckets < 2^31 - 2 for "
-            f"its combined int32 cell key; got {num_metrics} x {num_buckets}"
+            f"{path} ingest needs num_metrics * num_buckets < 2^31 - 2 "
+            f"for its combined int32 cell key; got "
+            f"{num_metrics} x {num_buckets}"
         )
+
+
 
 
 def make_sort_ingest_fn(bucket_limit: int, precision: int = PRECISION):
@@ -92,7 +104,7 @@ def make_sort_ingest_fn(bucket_limit: int, precision: int = PRECISION):
 
     @functools.partial(jax.jit, donate_argnums=0)
     def ingest(acc, ids, values):
-        validate_sort_ingest_shape(acc.shape[0], acc.shape[1])
+        validate_flat_cell_shape(acc.shape[0], acc.shape[1], "sort")
         return sort_ingest_batch(acc, ids, values, bucket_limit, precision)
 
     return ingest

@@ -42,7 +42,7 @@ from loghisto_tpu.ops.ingest import (
     make_weighted_ingest_fn,
     sanitize_ids,
 )
-from loghisto_tpu.ops.dispatch import choose_ingest_path
+from loghisto_tpu.ops.dispatch import resolve_ingest_path
 from loghisto_tpu.ops.stats import dense_stats, dense_stats_np
 from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
 from loghisto_tpu.registry import MetricRegistry, RegistryFullError
@@ -312,15 +312,16 @@ class TPUAggregator:
                 "checks could wrap an int32 cell"
             )
         self.spill_threshold = int(spill_threshold)
-        if ingest_path == "sort":
-            # validate BEFORE the accumulator allocation below — the
-            # combined-key bound failing after a multi-GB jnp.zeros is a
-            # worse failure mode than this early raise
-            from loghisto_tpu.ops.sort_ingest import (
-                validate_sort_ingest_shape,
+        if ingest_path in ("sort", "matmul", "hybrid"):
+            # validate explicit choices BEFORE the accumulator allocation
+            # below — the combined-key bound failing after a multi-GB
+            # jnp.zeros is a worse failure mode than a raise inside the
+            # traced ingest, which flush's shed-don't-block handling would
+            # mask as a down device (platform is irrelevant here)
+            resolve_ingest_path(
+                ingest_path, num_metrics, config.num_buckets, "any",
+                guard_metrics=self.max_metrics, batch_size=batch_size,
             )
-
-            validate_sort_ingest_shape(self.max_metrics, config.num_buckets)
         # int64 host fold of pre-spill interval counts (canonical dense
         # layout); engaged only when an interval exceeds spill_threshold
         self._spill: Optional[np.ndarray] = None
@@ -406,22 +407,13 @@ class TPUAggregator:
                 if mesh is not None
                 else jax.default_backend()
             )
-            ingest_path = choose_ingest_path(
-                num_metrics, config.num_buckets, platform
+            # shared guard policy: growth can take the row space to
+            # max_metrics, so auto validates shapes against the cap and
+            # must not pick a kernel the grown shape would invalidate
+            ingest_path = resolve_ingest_path(
+                "auto", num_metrics, config.num_buckets, platform,
+                guard_metrics=self.max_metrics, batch_size=batch_size,
             )
-            if ingest_path == "sort":
-                # growth can take the row space to max_metrics; auto must
-                # not pick a kernel the grown shape would invalidate
-                from loghisto_tpu.ops.sort_ingest import (
-                    validate_sort_ingest_shape,
-                )
-
-                try:
-                    validate_sort_ingest_shape(
-                        self.max_metrics, config.num_buckets
-                    )
-                except ValueError:
-                    ingest_path = "scatter"
         # identity for dense-layout paths; multirow slices its lane padding
         self._finalize_acc = lambda a: a
         # per-path zero-accumulator factory (layout differs by path)
@@ -443,16 +435,9 @@ class TPUAggregator:
                 config.bucket_limit, config.precision
             )
         elif ingest_path == "sort":
-            from loghisto_tpu.ops.sort_ingest import (
-                make_sort_ingest_fn,
-                validate_sort_ingest_shape,
-            )
+            # shape already validated (pre-allocation, against max_metrics)
+            from loghisto_tpu.ops.sort_ingest import make_sort_ingest_fn
 
-            # fail HERE, not inside the traced ingest where flush's
-            # failure handling would mask a config error as a down device
-            validate_sort_ingest_shape(
-                self.max_metrics, config.num_buckets
-            )
             self._ingest = make_sort_ingest_fn(
                 config.bucket_limit, config.precision
             )

@@ -21,7 +21,7 @@ def test_bench_main_emits_one_json_line(monkeypatch, capsys):
     out = capsys.readouterr().out.strip().splitlines()
     assert len(out) == 1
     payload = json.loads(out[0])
-    for key in ("metric", "value", "unit", "vs_baseline"):
+    for key in ("metric", "value", "unit", "vs_baseline", "ingest_path"):
         assert key in payload
     assert payload["value"] > 0
     assert payload["unit"] == "samples/s"

@@ -35,6 +35,44 @@ def test_choose_ingest_path_table():
     assert choose_ingest_path(10_000, 8193, "cpu") == "scatter"
 
 
+def test_resolve_ingest_path_guards_sort_shape():
+    from loghisto_tpu.ops.dispatch import resolve_ingest_path
+
+    # auto on TPU at high cardinality picks sort when the combined int32
+    # cell key fits, and falls back to scatter when it would wrap
+    assert resolve_ingest_path("auto", 10_000, 8193, "tpu") == "sort"
+    assert resolve_ingest_path("auto", 300_000, 8193, "tpu") == "scatter"
+    # an explicit unsupportable choice fails at selection time, not as a
+    # silently corrupted accumulator inside the traced kernel
+    with pytest.raises(ValueError):
+        resolve_ingest_path("sort", 300_000, 8193, "tpu")
+    # matmul's flat int32 cell index has the same wrap bound
+    with pytest.raises(ValueError):
+        resolve_ingest_path("matmul", 300_000, 8193, "tpu")
+    assert resolve_ingest_path("hybrid", 300_000, 8193, "tpu") == "hybrid"
+    # the aggregator guards against its GROWTH cap, not just num_metrics
+    with pytest.raises(ValueError):
+        resolve_ingest_path(
+            "sort", 10_000, 8193, "tpu", guard_metrics=300_000
+        )
+    # hybrid's float32 hot-head exactness needs per-batch n < 2^24
+    with pytest.raises(ValueError):
+        resolve_ingest_path(
+            "hybrid", 100, 8193, "tpu", batch_size=1 << 24
+        )
+    assert resolve_ingest_path(
+        "hybrid", 100, 8193, "tpu", batch_size=1 << 20
+    ) == "hybrid"
+
+
+def test_aggregator_rejects_hybrid_oversized_batch_at_construction():
+    with pytest.raises(ValueError):
+        TPUAggregator(
+            num_metrics=4, config=CFG, batch_size=1 << 24,
+            ingest_path="hybrid",
+        )
+
+
 def test_auto_is_default_and_resolves():
     agg = TPUAggregator(num_metrics=4, config=CFG, batch_size=64)
     # CI runs on CPU, where auto must resolve to scatter
