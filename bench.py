@@ -54,14 +54,15 @@ def _resolve_ingest_step(cfg, platform: str):
     import os
 
     from loghisto_tpu.ops.dispatch import ingest_step_fn, resolve_ingest_path
+    from loghisto_tpu.parallel.aggregator import DEFAULT_GROWTH_FACTOR
 
-    # mirror the default TPUAggregator's resolve call exactly (growth cap
-    # = num_metrics * 8, chunks of batch_size) so the benchmarked kernel
-    # can never drift from the kernel the default-configured product picks
+    # mirror the default TPUAggregator's resolve call exactly (its growth
+    # cap, chunks of batch_size) so the benchmarked kernel can never
+    # drift from the kernel the default-configured product picks
     path = resolve_ingest_path(
         os.environ.get("LOGHISTO_BENCH_PATH") or "auto",
         NUM_METRICS, cfg.num_buckets, platform,
-        guard_metrics=NUM_METRICS * 8, batch_size=BATCH,
+        guard_metrics=NUM_METRICS * DEFAULT_GROWTH_FACTOR, batch_size=BATCH,
     )
     return path, ingest_step_fn(path)
 

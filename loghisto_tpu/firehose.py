@@ -102,16 +102,19 @@ def make_mesh_firehose_step(
     config: MetricConfig,
     mean: float = 10.0,
     sigma: float = 2.0,
+    ingest_path: str = "auto",
 ):
     """Distributed firehose step over a ("stream","metric") mesh: each
     device generates its own sample shard (keys split per stream index),
-    builds a local dense histogram, psum-merges across the stream axis,
-    and folds into the metric-sharded accumulator — the BASELINE
-    configs[2] '8-way psum merge' exercised end to end."""
+    builds a local dense histogram via the dispatched accumulation kernel,
+    psum-merges across the stream axis, and folds into the metric-sharded
+    accumulator — the BASELINE configs[2] '8-way psum merge' exercised
+    end to end."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from loghisto_tpu.ops.dispatch import resolve_ingest_path
     from loghisto_tpu.parallel.aggregator import local_histogram_fold
     from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
 
@@ -121,6 +124,10 @@ def make_mesh_firehose_step(
         raise ValueError("metrics/batch must divide the mesh axes")
     rows = num_metrics // n_metric
     local_batch = batch // n_stream
+    ingest_path = resolve_ingest_path(
+        ingest_path, num_metrics, config.num_buckets,
+        mesh.devices.flat[0].platform, batch_size=local_batch,
+    )
     generate = _make_sample_generator(num_metrics, mean, sigma)
 
     def local(acc_local, key):
@@ -129,6 +136,7 @@ def make_mesh_firehose_step(
         return local_histogram_fold(
             acc_local, ids, values, rows,
             config.bucket_limit, config.precision,
+            ingest_path=ingest_path,
         )
 
     step = jax.shard_map(
@@ -168,13 +176,9 @@ def run_firehose(
 
     config = config or MetricConfig()
     if mesh is not None:
-        if ingest_path != "auto":
-            raise ValueError(
-                "ingest_path is single-device; the mesh firehose always "
-                "uses the shard_map local-fold step (drop ingest_path or "
-                "drop mesh)"
-            )
-        step = make_mesh_firehose_step(mesh, num_metrics, batch, config)
+        step = make_mesh_firehose_step(
+            mesh, num_metrics, batch, config, ingest_path=ingest_path
+        )
     else:
         step = make_firehose_step(
             num_metrics, batch, config, ingest_path=ingest_path

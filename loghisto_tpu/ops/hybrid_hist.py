@@ -93,9 +93,17 @@ def ingest_batch_hybrid(
         lane_p = jnp.concatenate([lane, jnp.zeros(pad, lane.dtype)])
     else:
         col_p, lane_p = col, lane
+    # seed the scan carry FROM the inputs (int32 * 0 is exactly zero, and
+    # col is never NaN): a constant jnp.zeros carry is "unvarying" under
+    # shard_map's varying-manual-axes typing while the body output is
+    # varying, which rejects the scan — this kernel must stay usable
+    # inside the mesh local fold without knowing the axis names
+    zero_carry = jnp.zeros((hot * h, LANES), dtype=jnp.float32) + (
+        col_p[0] * 0
+    ).astype(jnp.float32)
     hot_hist, _ = jax.lax.scan(
         tile_hist,
-        jnp.zeros((hot * h, LANES), dtype=jnp.float32),
+        zero_carry,
         (col_p.reshape(tiles, sample_tile),
          lane_p.reshape(tiles, sample_tile)),
     )

@@ -37,7 +37,6 @@ from loghisto_tpu.config import DEFAULT_PERCENTILES, PRECISION, MetricConfig
 from loghisto_tpu.metrics import MetricSystem, ProcessedMetricSet, RawMetricSet
 from loghisto_tpu.channel import Channel, ChannelClosed
 from loghisto_tpu.ops.ingest import (
-    bucket_indices,
     make_ingest_fn,
     make_weighted_ingest_fn,
     sanitize_ids,
@@ -46,6 +45,11 @@ from loghisto_tpu.ops.dispatch import resolve_ingest_path
 from loghisto_tpu.ops.stats import dense_stats, dense_stats_np
 from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
 from loghisto_tpu.registry import MetricRegistry, RegistryFullError
+
+# Default registry-growth headroom: max_metrics = num_metrics * this when
+# unspecified.  Shared with bench.py's path resolution so the benchmarked
+# default kernel tracks the default-configured aggregator's exactly.
+DEFAULT_GROWTH_FACTOR = 8
 
 # Fixed launch width for weighted cell merges (bridge intervals, preagg
 # flushes): one compiled executable serves every merge, and a 10k-metric
@@ -60,17 +64,30 @@ def local_histogram_fold(
     rows_per_shard: int,
     bucket_limit: int,
     precision: int = PRECISION,
+    ingest_path: str = "scatter",
 ) -> jnp.ndarray:
     """The sharded-ingest core, shared by every shard_map step: offset ids
     into this metric shard's row range (ids below it go negative, so
     sanitize before drop-mode scatter or they'd wrap to the last row),
     bucket the local sample shard, psum the dense histograms across the
     stream axis, and fold into the accumulator.  Must run inside
-    shard_map on a ("stream", "metric") mesh."""
+    shard_map on a ("stream", "metric") mesh.
+
+    ``ingest_path`` names a CONCRETE per-batch kernel ("scatter", "sort",
+    "hybrid", "matmul" — resolve "auto" outside the traced region): the
+    duplicate-serialization economics that drive single-chip dispatch
+    apply unchanged to the per-device local fold (a Zipf stream
+    concentrates each shard's in-range samples on its hot rows), so the
+    mesh path uses the same dispatched kernels.  Out-of-shard ids are
+    sanitized far out of range, which every kernel drops."""
+    from loghisto_tpu.ops.dispatch import ingest_step_fn
+
     shard = jax.lax.axis_index(METRIC_AXIS)
     local_ids = sanitize_ids(ids - shard * rows_per_shard)
-    bidx = bucket_indices(values, bucket_limit, precision)
-    hist = jnp.zeros_like(acc_local).at[local_ids, bidx].add(1, mode="drop")
+    hist = ingest_step_fn(ingest_path)(
+        jnp.zeros_like(acc_local), local_ids, values, bucket_limit,
+        precision,
+    )
     hist = jax.lax.psum(hist, STREAM_AXIS)
     return acc_local + hist
 
@@ -81,6 +98,7 @@ def make_distributed_step(
     bucket_limit: int,
     percentile_values,
     precision: int = PRECISION,
+    ingest_path: str = "auto",
 ):
     """Build the jitted full aggregation step over a ("stream", "metric")
     mesh.
@@ -105,10 +123,18 @@ def make_distributed_step(
         )
     rows_per_shard = num_metrics // n_metric
     ps = jnp.asarray(percentile_values, dtype=jnp.float32)
+    # resolve dispatch OUTSIDE the traced region: choose on the global
+    # metric count (duplicate-heaviness tracks global hotness), validate
+    # on it too (stricter than the local shard shape, never looser)
+    ingest_path = resolve_ingest_path(
+        ingest_path, num_metrics,
+        2 * bucket_limit + 1, mesh.devices.flat[0].platform,
+    )
 
     def local_step(acc_local, ids, values):
         acc_local = local_histogram_fold(
-            acc_local, ids, values, rows_per_shard, bucket_limit, precision
+            acc_local, ids, values, rows_per_shard, bucket_limit, precision,
+            ingest_path=ingest_path,
         )
         stats = dense_stats(acc_local, ps, bucket_limit, precision)
         return acc_local, stats
@@ -294,7 +320,8 @@ class TPUAggregator:
             )
         self.on_registry_full = on_registry_full
         self.max_metrics = (
-            int(max_metrics) if max_metrics is not None else num_metrics * 8
+            int(max_metrics) if max_metrics is not None
+            else num_metrics * DEFAULT_GROWTH_FACTOR
         )
         if self.max_metrics < num_metrics:
             raise ValueError(
