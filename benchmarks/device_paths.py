@@ -237,7 +237,9 @@ def _run_table(args, cfg, rng, n, platform, looped, measure, results):
     from loghisto_tpu.ops.ingest import make_ingest_fn
     from loghisto_tpu.ops.sort_ingest import (
         make_sort_ingest_fn,
+        make_sortscan_ingest_fn,
         sort_ingest_batch,
+        sortscan_ingest_batch,
     )
 
     values = rng.lognormal(8, 2, n).astype(np.float32)
@@ -253,6 +255,13 @@ def _run_table(args, cfg, rng, n, platform, looped, measure, results):
                 lambda a, i, v: sort_ingest_batch(
                     a, i, v, cfg.bucket_limit),
                 make_sort_ingest_fn(cfg.bucket_limit), acc, (ids, values))
+
+        acc = jnp.zeros((m, cfg.num_buckets), dtype=jnp.int32)
+        measure(m, "sortscan",
+                lambda a, i, v: sortscan_ingest_batch(
+                    a, i, v, cfg.bucket_limit),
+                make_sortscan_ingest_fn(cfg.bucket_limit), acc,
+                (ids, values))
 
         if m * cfg.num_buckets <= 1 << 23:
             acc = jnp.zeros((m, cfg.num_buckets), dtype=jnp.int32)

@@ -1,7 +1,8 @@
 """Automatic ingest-path selection (VERDICT r1 item 6).
 
-Five bit-identical device accumulation kernels exist (scatter / sort-dedup
-scatter / one-hot MXU matmul / Pallas row / Pallas multirow); they differ
+Six bit-identical device accumulation kernels exist (scatter / sort-dedup
+scatter / scan-based sort-dedup ("sortscan") / one-hot MXU matmul /
+Pallas row / Pallas multirow, plus the hot-row hybrid); they differ
 only in speed per (num_metrics, num_buckets, platform) configuration.
 ``TPUAggregator(ingest_path="auto")`` — the default — calls
 ``choose_ingest_path`` at construction (platform is known then; this is
@@ -89,7 +90,7 @@ def resolve_ingest_path(
                 validate_flat_cell_shape(guard, num_buckets, "sort")
             except ValueError:
                 path = "scatter"
-    elif path in ("sort", "matmul"):
+    elif path in ("sort", "sortscan", "matmul"):
         validate_flat_cell_shape(guard, num_buckets, path)
     elif path == "hybrid" and batch_size is not None and batch_size >= 1 << 24:
         raise ValueError(
@@ -110,6 +111,10 @@ def ingest_step_fn(path: str):
         from loghisto_tpu.ops.sort_ingest import sort_ingest_batch
 
         return sort_ingest_batch
+    if path == "sortscan":
+        from loghisto_tpu.ops.sort_ingest import sortscan_ingest_batch
+
+        return sortscan_ingest_batch
     if path == "hybrid":
         from loghisto_tpu.ops.hybrid_hist import ingest_batch_hybrid
 
@@ -121,7 +126,7 @@ def ingest_step_fn(path: str):
     if path != "scatter":
         raise ValueError(
             f"no pure step form for ingest_path {path!r}: expected "
-            "'scatter', 'sort', 'hybrid', or 'matmul'"
+            "'scatter', 'sort', 'sortscan', 'hybrid', or 'matmul'"
         )
     from loghisto_tpu.ops.ingest import ingest_batch
 

@@ -339,7 +339,7 @@ class TPUAggregator:
                 "checks could wrap an int32 cell"
             )
         self.spill_threshold = int(spill_threshold)
-        if ingest_path in ("sort", "matmul", "hybrid"):
+        if ingest_path in ("sort", "sortscan", "matmul", "hybrid"):
             # validate explicit choices BEFORE the accumulator allocation
             # below — the combined-key bound failing after a multi-GB
             # jnp.zeros is a worse failure mode than a raise inside the
@@ -468,6 +468,12 @@ class TPUAggregator:
             self._ingest = make_sort_ingest_fn(
                 config.bucket_limit, config.precision
             )
+        elif ingest_path == "sortscan":
+            from loghisto_tpu.ops.sort_ingest import make_sortscan_ingest_fn
+
+            self._ingest = make_sortscan_ingest_fn(
+                config.bucket_limit, config.precision
+            )
         elif ingest_path == "multirow":
             if mesh is not None:
                 raise ValueError(
@@ -487,7 +493,8 @@ class TPUAggregator:
         else:
             raise ValueError(
                 f"unknown ingest_path {ingest_path!r}: expected 'auto', "
-                "'scatter', 'matmul', 'sort', 'hybrid', or 'multirow'"
+                "'scatter', 'matmul', 'sort', 'sortscan', 'hybrid', or "
+                "'multirow'"
             )
         self.ingest_path = ingest_path
         self._weighted_ingest = make_weighted_ingest_fn(config.bucket_limit)

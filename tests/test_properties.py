@@ -150,6 +150,11 @@ def test_sort_ingest_always_matches_scatter(samples):
     ref = np.asarray(ingest_batch(acc, ids, values, bl))
     got = np.asarray(sort_ingest_batch(acc, ids, values, bl))
     np.testing.assert_array_equal(got, ref)
+    # and the scan-based dedup formulation, same contract
+    from loghisto_tpu.ops.sort_ingest import sortscan_ingest_batch
+
+    got2 = np.asarray(sortscan_ingest_batch(acc, ids, values, bl))
+    np.testing.assert_array_equal(got2, ref)
 
 
 @given(
