@@ -223,6 +223,7 @@ def main():
 
 
 def _run_table(args, cfg, rng, n, platform, looped, measure, results):
+    import jax
     import jax.numpy as jnp
 
     from loghisto_tpu.ops.ingest import ingest_batch
@@ -279,6 +280,19 @@ def _run_table(args, cfg, rng, n, platform, looped, measure, results):
                     make_pallas_row_ingest(cfg.num_buckets,
                                            cfg.bucket_limit),
                     row, (values,), needs_ids=False)
+
+            # the masked (ids, values) form auto-dispatch actually picks
+            from loghisto_tpu.ops.pallas_kernels import (
+                pallas_row_ingest_batch,
+            )
+
+            acc = jnp.zeros((1, cfg.num_buckets), dtype=jnp.int32)
+            measure(m, "pallasb",
+                    lambda a, i, v: pallas_row_ingest_batch(
+                        a, i, v, cfg.bucket_limit),
+                    jax.jit(lambda a, i, v: pallas_row_ingest_batch(
+                        a, i, v, cfg.bucket_limit), donate_argnums=0),
+                    acc, (ids, values))
 
         if m >= 256:
             from loghisto_tpu.ops.hybrid_hist import (

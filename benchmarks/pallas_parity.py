@@ -26,10 +26,14 @@ def main():
     plat = jax.devices()[0].platform
     print(f"platform={plat} (interpret={'cpu' == plat})")
 
+    import os
+
     cfg = MetricConfig(bucket_limit=4096)
     rng = np.random.default_rng(7)
-    n = 1 << 18
-    n = n // SAMPLE_TILE * SAMPLE_TILE
+    # full size on hardware; overridable so a CPU interpret-mode sanity
+    # run finishes in seconds instead of tens of minutes
+    n = int(os.environ.get("LOGHISTO_PARITY_N", 1 << 18))
+    n = max(SAMPLE_TILE, n // SAMPLE_TILE * SAMPLE_TILE)
     # adversarial values: lognormal bulk + negatives + zeros + tiny + huge
     values = rng.lognormal(8, 4, n).astype(np.float32)
     values[: n // 8] *= -1.0
@@ -51,6 +55,25 @@ def main():
     else:
         bad = np.nonzero(ref != got)[0]
         print(f"PARITY FAIL pallas_row   {bad.size} cells differ, first={bad[:5]}")
+        failures += 1
+
+    # --- masked (ids, values) row form: ragged N + invalid-id drop ---
+    from loghisto_tpu.ops.pallas_kernels import pallas_row_ingest_batch
+
+    n_rag = n - SAMPLE_TILE // 2  # deliberately ragged
+    ids_mix = rng.integers(-1, 3, n_rag).astype(np.int32)
+    ref = np.asarray(scatter(
+        jnp.zeros((1, cfg.num_buckets), jnp.int32), ids_mix,
+        values[:n_rag],
+    ))
+    got = np.asarray(jax.jit(
+        lambda a, i, v: pallas_row_ingest_batch(a, i, v, cfg.bucket_limit)
+    )(jnp.zeros((1, cfg.num_buckets), jnp.int32), ids_mix, values[:n_rag]))
+    if np.array_equal(ref, got):
+        print(f"PARITY OK  pallas_masked n={n_rag} sum={got.sum()}")
+    else:
+        bad = np.nonzero(ref != got)
+        print(f"PARITY FAIL pallas_masked {bad[0].size} cells differ")
         failures += 1
 
     # --- multirow kernel vs scatter at several metric counts ---

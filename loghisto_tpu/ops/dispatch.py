@@ -48,11 +48,15 @@ def choose_ingest_path(
     The Pallas multirow kernel stays opt-in: hardware-validated for
     parity (TPU_CAPTURE_r2/pallas_parity.json) but never the fastest at
     any measured config, so "auto" does not select it.  The Pallas row
-    kernel wins M=1 but has a different call signature (no ids); the
-    aggregator's batch interface needs the (ids, values) forms, so auto
-    picks sort/scatter and PrintBenchmark-style single-metric users reach
-    the row kernel via ops.pallas_kernels directly.
+    kernel (winner at M=1) participates via its masked
+    pallas_row_ingest_batch form, which has the standard (ids, values)
+    contract.
     """
+    if platform == "tpu" and num_metrics == 1:
+        # the fused Pallas row kernel wins the single-metric config
+        # outright (r2 hardware table); its masked (ids, values) form
+        # makes it contract-compatible with the other paths
+        return "pallas"
     if platform == "tpu" and num_metrics >= SORT_MIN_METRICS:
         return "sort"
     return "scatter"
@@ -83,19 +87,34 @@ def resolve_ingest_path(
     from loghisto_tpu.ops.sort_ingest import validate_flat_cell_shape
 
     guard = max(num_metrics, guard_metrics or 0)
+    batch_too_big = batch_size is not None and batch_size >= 1 << 24
     if path == "auto":
+        # auto never raises for a precondition: it just doesn't pick the
+        # kernel the shape/batch would invalidate
         path = choose_ingest_path(num_metrics, num_buckets, platform)
         if path == "sort":
             try:
                 validate_flat_cell_shape(guard, num_buckets, "sort")
             except ValueError:
                 path = "scatter"
-    elif path in ("sort", "sortscan", "matmul"):
+        elif path == "pallas" and (guard != 1 or batch_too_big):
+            # registry growth can widen the row space past the
+            # single-row kernel; auto must not pick it unless the cap
+            # pins M=1 (explicit "pallas" instead swaps kernels on grow)
+            path = "scatter"
+        return path
+    if path in ("sort", "sortscan", "matmul"):
         validate_flat_cell_shape(guard, num_buckets, path)
-    elif path == "hybrid" and batch_size is not None and batch_size >= 1 << 24:
+    elif path in ("hybrid", "pallas") and batch_too_big:
         raise ValueError(
-            f"hybrid ingest batches must stay < 2^24 samples (float32 "
-            f"hot-head exactness); got batch_size={batch_size}"
+            f"{path} ingest batches must stay < 2^24 samples (float32 "
+            f"accumulation exactness); got batch_size={batch_size}"
+        )
+    if path == "pallas" and num_metrics != 1:
+        raise ValueError(
+            "ingest_path='pallas' is the single-metric row kernel; got "
+            f"num_metrics={num_metrics} (growth past 1 row swaps kernels "
+            "automatically, but the starting shape must be [1, B])"
         )
     return path
 
@@ -103,8 +122,9 @@ def resolve_ingest_path(
 def ingest_step_fn(path: str):
     """The pure per-batch accumulation function for a named path, with the
     uniform ``f(acc, ids, values, bucket_limit, precision) -> acc``
-    contract (scatter / sort / hybrid / matmul — the paths whose dense
-    accumulator layout is interchangeable).  Used wherever a traced step
+    contract (scatter / sort / sortscan / hybrid / matmul / pallas — the
+    paths whose dense accumulator layout is interchangeable; pallas
+    additionally requires acc shape [1, B]).  Used wherever a traced step
     needs the dispatched kernel inline (firehose generation loop, bench
     interval loop) rather than the TPUAggregator's jitted wrappers."""
     if path == "sort":
@@ -123,10 +143,15 @@ def ingest_step_fn(path: str):
         from loghisto_tpu.ops.matmul_hist import ingest_batch_matmul
 
         return ingest_batch_matmul
+    if path == "pallas":
+        from loghisto_tpu.ops.pallas_kernels import pallas_row_ingest_batch
+
+        return pallas_row_ingest_batch
     if path != "scatter":
         raise ValueError(
             f"no pure step form for ingest_path {path!r}: expected "
-            "'scatter', 'sort', 'sortscan', 'hybrid', or 'matmul'"
+            "'scatter', 'sort', 'sortscan', 'hybrid', 'matmul', or "
+            "'pallas'"
         )
     from loghisto_tpu.ops.ingest import ingest_batch
 

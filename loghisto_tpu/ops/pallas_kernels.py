@@ -92,9 +92,9 @@ def pallas_histogram_row(
     """Accumulate `values` into a single dense histogram row.
 
     acc_row: int32 [num_buckets]; values: float32 [N] with N a multiple of
-    SAMPLE_TILE (pad with NaN->bucket 0? no — pad with 0.0 and subtract? —
-    callers use pallas_histogram_row_padded for arbitrary N).
-    Returns the updated row.
+    SAMPLE_TILE (for ragged N or an (ids, values) contract use
+    pallas_row_ingest_batch below, whose mask drops padding and non-zero
+    ids).  Returns the updated row.
     """
     if interpret is None:
         interpret = not _on_tpu()
@@ -155,3 +155,102 @@ def make_pallas_row_ingest(
         )
 
     return ingest
+
+
+def _hist_kernel_masked(values_ref, mask_ref, acc_ref, out_ref, scratch_ref,
+                        *, bucket_limit: int, precision: int, h: int):
+    """Masked variant of _hist_kernel: samples whose mask is 0 contribute
+    nothing (their one-hot row is zeroed) — this is what gives the row
+    kernel a drop semantics for invalid ids and arbitrary-N padding."""
+    i = pl.program_id(0)
+    n_steps = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _init():
+        scratch_ref[:] = jnp.zeros_like(scratch_ref)
+
+    v = values_ref[0, :]
+    m = mask_ref[0, :] != 0  # [T] bool
+    bucket = bucket_indices(v, bucket_limit, precision)
+    hi = bucket // LANES
+    lo = bucket % LANES
+    hi_iota = jax.lax.broadcasted_iota(jnp.int32, (v.shape[0], h), 1)
+    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (v.shape[0], LANES), 1)
+    onehot_hi = (
+        (hi[:, None] == hi_iota) & m[:, None]
+    ).astype(jnp.bfloat16)
+    onehot_lo = (lo[:, None] == lo_iota).astype(jnp.bfloat16)
+    partial = jax.lax.dot_general(
+        onehot_hi, onehot_lo,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    scratch_ref[:] += partial
+
+    @pl.when(i == n_steps - 1)
+    def _finalize():
+        out_ref[:] = acc_ref[:] + scratch_ref[:].astype(jnp.int32)
+
+
+def pallas_row_ingest_batch(
+    acc: jnp.ndarray,
+    ids: jnp.ndarray,
+    values: jnp.ndarray,
+    bucket_limit: int,
+    precision: int = PRECISION,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Uniform-contract form of the row kernel: acc int32 [1, B],
+    f(acc, ids, values) -> acc, bit-identical to the scatter path for a
+    single-metric accumulator (samples with ids != 0 are dropped via the
+    mask; ragged N is padded with masked-out samples).  This is what
+    lets ``ingest_path="auto"``/"pallas" reach the measured-fastest M=1
+    kernel through the same dispatch table as every other path."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    if acc.ndim != 2 or acc.shape[0] != 1:
+        raise ValueError(
+            f"pallas row path needs a single-metric [1, B] accumulator; "
+            f"got shape {tuple(acc.shape)}"
+        )
+    b = acc.shape[1]
+    h = (b + LANES - 1) // LANES
+    n = values.shape[0]
+    mask = (ids == 0).astype(jnp.int32)
+    pad = (-n) % SAMPLE_TILE
+    if n + pad >= MAX_SAMPLES_PER_CALL:
+        raise ValueError(
+            f"N={n} >= 2^24: the float32 scratch would silently saturate; "
+            "split the batch across calls"
+        )
+    if pad:
+        values = jnp.concatenate(
+            [values, jnp.zeros(pad, values.dtype)]
+        )
+        mask = jnp.concatenate([mask, jnp.zeros(pad, mask.dtype)])
+    g = (n + pad) // SAMPLE_TILE
+
+    acc2d = jnp.zeros((h, LANES), dtype=jnp.int32)
+    acc2d = acc2d.reshape(-1).at[:b].set(acc[0]).reshape(h, LANES)
+    kernel = functools.partial(
+        _hist_kernel_masked, bucket_limit=bucket_limit,
+        precision=precision, h=h,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec((1, SAMPLE_TILE), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, SAMPLE_TILE), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((h, LANES), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((h, LANES), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((h, LANES), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((h, LANES), jnp.float32)],
+        interpret=interpret,
+    )(values.reshape(1, -1), mask.reshape(1, -1), acc2d)
+    return out.reshape(-1)[:b][None, :]

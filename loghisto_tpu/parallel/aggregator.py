@@ -339,7 +339,7 @@ class TPUAggregator:
                 "checks could wrap an int32 cell"
             )
         self.spill_threshold = int(spill_threshold)
-        if ingest_path in ("sort", "sortscan", "matmul", "hybrid"):
+        if ingest_path in ("sort", "sortscan", "matmul", "hybrid", "pallas"):
             # validate explicit choices BEFORE the accumulator allocation
             # below — the combined-key bound failing after a multi-GB
             # jnp.zeros is a worse failure mode than a raise inside the
@@ -474,6 +474,8 @@ class TPUAggregator:
             self._ingest = make_sortscan_ingest_fn(
                 config.bucket_limit, config.precision
             )
+        elif ingest_path == "pallas":
+            self._ingest = self._make_dense_step_fn("pallas")
         elif ingest_path == "multirow":
             if mesh is not None:
                 raise ValueError(
@@ -557,6 +559,21 @@ class TPUAggregator:
                 )
             return -1
 
+    def _make_dense_step_fn(self, path: str):
+        """Jitted donated-accumulator wrapper over any dense-layout
+        dispatched kernel (all paths share the [*, B] accumulator, so
+        growth can swap kernels without touching the data)."""
+        from loghisto_tpu.ops.dispatch import ingest_step_fn
+
+        step = ingest_step_fn(path)
+        bl, prec = self.config.bucket_limit, self.config.precision
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def ingest(acc, ids, values):
+            return step(acc, ids, values, bl, prec)
+
+        return ingest
+
     def _grow_row_unit(self) -> int:
         """Row-count granularity growth must preserve: the mesh metric
         axis (shard divisibility) or the multirow kernel's row tile."""
@@ -587,12 +604,27 @@ class TPUAggregator:
         make_acc, ingest, finalize = (
             self._make_acc, self._ingest, self._finalize_acc
         )
+        new_path = self.ingest_path
         if self.ingest_path == "multirow":
             from loghisto_tpu.ops.pallas_multirow import make_multirow_ingest
 
             make_acc, ingest, finalize = make_multirow_ingest(
                 new_m, self.config.bucket_limit, self.config.precision
             )
+        elif self.ingest_path == "pallas":
+            # the single-row kernel cannot cover more rows; swap to the
+            # auto-dispatched dense-family kernel for the grown shape
+            # (same [*, B] layout, so the data moves unchanged)
+            platform = (
+                self.mesh.devices.flat[0].platform
+                if self.mesh is not None
+                else jax.default_backend()
+            )
+            new_path = resolve_ingest_path(
+                "auto", new_m, self.config.num_buckets, platform,
+                guard_metrics=self.max_metrics, batch_size=self.batch_size,
+            )
+            ingest = self._make_dense_step_fn(new_path)
         acc_np = np.asarray(self._acc)
         grown = np.zeros((new_m, acc_np.shape[1]), dtype=acc_np.dtype)
         grown[:old_m] = acc_np
@@ -606,6 +638,7 @@ class TPUAggregator:
         self._make_acc, self._ingest, self._finalize_acc = (
             make_acc, ingest, finalize
         )
+        self.ingest_path = new_path
         self._acc = new_acc
         self.num_metrics = new_m
         self.registry.grow(new_m)

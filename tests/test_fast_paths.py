@@ -198,6 +198,68 @@ def test_sortscan_via_aggregator_and_firehose_parity():
     np.testing.assert_array_equal(accs["scatter"], accs["sortscan"])
 
 
+def test_pallas_row_batch_matches_scatter_with_invalid_ids():
+    """The masked (ids, values) form of the row kernel drops non-zero
+    ids and ragged-N padding, bit-identical to scatter on [1, B]."""
+    from loghisto_tpu.ops.ingest import make_ingest_fn
+    from loghisto_tpu.ops.pallas_kernels import pallas_row_ingest_batch
+
+    cfg = MetricConfig(bucket_limit=256)
+    rng = np.random.default_rng(3)
+    n = 5000  # deliberately NOT a multiple of SAMPLE_TILE
+    ids = rng.integers(-1, 3, n).astype(np.int32)  # mix of 0 and invalid
+    values = rng.lognormal(3, 2, n).astype(np.float32)
+    values[:32] = np.nan
+    values[32:64] *= -1
+    scatter = make_ingest_fn(cfg.bucket_limit)
+    ref = np.asarray(
+        scatter(jnp.zeros((1, cfg.num_buckets), jnp.int32), ids, values)
+    )
+    got = np.asarray(
+        pallas_row_ingest_batch(
+            jnp.zeros((1, cfg.num_buckets), jnp.int32), ids, values,
+            cfg.bucket_limit,
+        )
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_pallas_row_batch_rejects_multi_row_acc():
+    from loghisto_tpu.ops.pallas_kernels import pallas_row_ingest_batch
+
+    with pytest.raises(ValueError, match="single-metric"):
+        pallas_row_ingest_batch(
+            jnp.zeros((2, 513), jnp.int32),
+            np.zeros(8, np.int32), np.ones(8, np.float32), 256,
+        )
+
+
+def test_pallas_aggregator_and_growth_swap():
+    """Explicit pallas path works through the aggregator, and registry
+    growth past one row swaps to a dense-family kernel without losing
+    the accumulated row."""
+    from loghisto_tpu.parallel.aggregator import TPUAggregator
+
+    agg = TPUAggregator(
+        num_metrics=1, config=MetricConfig(bucket_limit=64),
+        ingest_path="pallas", batch_size=512, max_metrics=4,
+    )
+    agg.registry.id_for("first")
+    agg.record_batch(
+        np.zeros(1000, np.int32), np.full(1000, 7.5, np.float32)
+    )
+    agg.flush()
+    assert agg.ingest_path == "pallas"
+    # second name triggers growth -> kernel family swap
+    agg.record("second", 3.25)
+    agg.flush()
+    assert agg.num_metrics > 1
+    assert agg.ingest_path != "pallas"
+    out = agg.collect().metrics
+    assert out["first_count"] == 1000
+    assert out["second_count"] == 1
+
+
 def test_sort_ingest_accumulates_and_zipf_hot_cell():
     from loghisto_tpu.ops.sort_ingest import make_sort_ingest_fn
 

@@ -28,7 +28,7 @@ def test_choose_ingest_path_table():
     # thresholds refreshed from the r2 hardware table
     # (TPU_CAPTURE_r2/device_paths.json): scatter dominates the low/mid
     # range, sort-dedup wins back high metric cardinality on TPU
-    assert choose_ingest_path(1, 8193, "tpu") == "scatter"
+    assert choose_ingest_path(1, 8193, "tpu") == "pallas"
     assert choose_ingest_path(128, 8193, "tpu") == "scatter"
     assert choose_ingest_path(10_000, 8193, "tpu") == "sort"
     assert choose_ingest_path(1, 8193, "cpu") == "scatter"
@@ -63,6 +63,19 @@ def test_resolve_ingest_path_guards_sort_shape():
     assert resolve_ingest_path(
         "hybrid", 100, 8193, "tpu", batch_size=1 << 20
     ) == "hybrid"
+    # pallas: auto picks it at M=1 only when the growth cap pins M=1
+    assert resolve_ingest_path("auto", 1, 8193, "tpu") == "pallas"
+    assert resolve_ingest_path(
+        "auto", 1, 8193, "tpu", guard_metrics=8
+    ) == "scatter"
+    # auto must apply the same batch bound explicit pallas enforces —
+    # never defer a precondition into the traced kernel
+    assert resolve_ingest_path(
+        "auto", 1, 8193, "tpu", batch_size=1 << 24
+    ) == "scatter"
+    # explicit pallas demands a [1, B] starting shape
+    with pytest.raises(ValueError, match="single-metric"):
+        resolve_ingest_path("pallas", 16, 8193, "tpu")
 
 
 def test_aggregator_rejects_hybrid_oversized_batch_at_construction():
