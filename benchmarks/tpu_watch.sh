@@ -8,12 +8,37 @@ TAG="${1:-r2}"
 MAX_HOURS="${2:-11}"
 DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
 ATTEMPT=0
+# A wedged tunnel hangs PJRT init ~25 min before failing; a HEALTHY init
+# completes in well under a minute.  Kill attempts still stuck in init
+# after INIT_TIMEOUT so the retry cadence tracks short healthy windows
+# (one init per process either way — the probe IS the capture).
+INIT_TIMEOUT=360
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   ATTEMPT=$((ATTEMPT + 1))
   OUT="tpu_results_${TAG}_a${ATTEMPT}"
+  LOG="${OUT}.log"
   echo "=== attempt $ATTEMPT -> $OUT ($(date)) ==="
-  timeout 3900 python benchmarks/tpu_oneshot.py "$OUT"
+  # own process group so the wedge-kill can reach the python child even
+  # when it is stuck inside an uninterruptible PJRT C call (killing just
+  # the timeout wrapper would orphan it, still holding the device)
+  setsid timeout 3900 python benchmarks/tpu_oneshot.py "$OUT" > "$LOG" 2>&1 &
+  PID=$!
+  WAITED=0
+  while kill -0 "$PID" 2>/dev/null; do
+    if [ "$WAITED" -ge "$INIT_TIMEOUT" ] && \
+       ! grep -q 'platform=' "$LOG" 2>/dev/null; then
+      echo "=== attempt $ATTEMPT: init still wedged after ${WAITED}s; killing ==="
+      kill -TERM -- "-$PID" 2>/dev/null
+      sleep 2
+      kill -9 -- "-$PID" 2>/dev/null
+      break
+    fi
+    sleep 15
+    WAITED=$((WAITED + 15))
+  done
+  wait "$PID" 2>/dev/null
   rc=$?
+  tail -5 "$LOG" 2>/dev/null
   if [ -f "$OUT/SUCCESS" ]; then
     echo "=== CAPTURED on attempt $ATTEMPT; results in $OUT ==="
     exit 0
@@ -27,7 +52,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   fi
   # rc=2: init reached a non-TPU platform; rc=124: timeout/wedge
   echo "=== attempt $ATTEMPT failed rc=$rc; sleeping 300s ==="
-  rm -rf "$OUT" 2>/dev/null
+  rm -rf "$OUT" "$LOG" 2>/dev/null
   sleep 300
 done
 echo "=== gave up after $ATTEMPT attempts ==="
