@@ -1,0 +1,94 @@
+"""Turn a capture directory into a kernel ranking + dispatch advice.
+
+Usage: python benchmarks/analyze_capture.py TPU_CAPTURE_r2b [...]
+
+Reads each directory's ``device_paths.json`` (written by
+benchmarks/tpu_oneshot.py stage 5 / benchmarks/device_paths.py) and
+prints, per metric count, the measured ranking plus the winner — then
+compares the winners against what ``ops/dispatch.py`` would choose, so
+refreshing the dispatch thresholds after a capture is a mechanical
+diff-and-edit instead of a judgment call.  Pure stdlib; safe to run
+anywhere (no jax import).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _load_choose():
+    """Load choose_ingest_path from ops/dispatch.py WITHOUT importing the
+    loghisto_tpu package (whose __init__ chain pulls in jax) — the module
+    file itself is stdlib-only, which keeps this script runnable on any
+    machine holding a copy of the capture."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "loghisto_tpu", "ops", "dispatch.py",
+    )
+    spec = importlib.util.spec_from_file_location("_lh_dispatch", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.choose_ingest_path
+
+
+def load(dirname: str) -> dict | None:
+    path = os.path.join(dirname, "device_paths.json")
+    if not os.path.exists(path):
+        print(f"{dirname}: no device_paths.json")
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def analyze(dirname: str, table: dict) -> None:
+    rates: dict[str, float] = table.get("rates", {})
+    errors: dict[str, str] = table.get("errors", {})
+    by_m: dict[int, list[tuple[float, str]]] = {}
+    for key, rate in rates.items():
+        name, m = key.rsplit("@", 1)
+        by_m.setdefault(int(m), []).append((rate, name))
+    print(f"\n== {dirname} (platform={table.get('platform')}, "
+          f"mode={table.get('mode')}) ==")
+    winners: dict[int, str] = {}
+    for m in sorted(by_m):
+        ranked = sorted(by_m[m], reverse=True)
+        winners[m] = ranked[0][1]
+        line = " > ".join(f"{n} {r:.3g}" for r, n in ranked)
+        print(f"M={m:<6} {line}")
+    for key, err in errors.items():
+        print(f"   error {key}: {err}")
+    if table.get("platform") != "tpu" or not winners:
+        return
+    choose_ingest_path = _load_choose()
+
+    print("dispatch check (auto vs measured winner):")
+    for m, winner in sorted(winners.items()):
+        auto = choose_ingest_path(m, 8193, "tpu")
+        # the no-ids pallas row form isn't an (ids, values) candidate;
+        # its dispatchable twin is "pallasb"
+        mark = "OK" if auto == winner or (
+            auto == "pallas" and winner in ("pallas", "pallasb")
+        ) else "REVISIT"
+        print(f"  M={m:<6} auto={auto:<8} measured={winner:<8} {mark}")
+
+
+def main() -> int:
+    dirs = sys.argv[1:] or sorted(
+        d for d in os.listdir(".")
+        if d.startswith("TPU_CAPTURE") and os.path.isdir(d)
+    )
+    found = False
+    for d in dirs:
+        table = load(d)
+        if table:
+            analyze(d, table)
+            found = True
+    return 0 if found else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
