@@ -30,10 +30,6 @@ BASELINE_SAMPLES_PER_S = 2.017e7
 NUM_METRICS = 10_000
 BUCKET_LIMIT = 4_096
 BATCH = 1 << 22  # 4.2M samples per step
-STEPS = 16
-# One full statistics extraction per simulated interval; 16 batches
-# (~67M samples) per interval approximates a 1s interval at TPU rates.
-STATS_EVERY = 16
 # Looped-interval mode (TPU): ROUNDS passes over DISTINCT_BATCHES
 # pre-staged batches inside ONE jit dispatch, stats once at the end.
 # Distinct batches stop XLA hoisting the compress as loop-invariant;

@@ -81,6 +81,11 @@ def main() -> int:
         d for d in os.listdir(".")
         if d.startswith("TPU_CAPTURE") and os.path.isdir(d)
     )
+    if not dirs:
+        print("no TPU_CAPTURE* directories here; pass capture dirs as "
+              "arguments (e.g. python benchmarks/analyze_capture.py "
+              "TPU_CAPTURE_r2b)", file=sys.stderr)
+        return 1
     found = False
     for d in dirs:
         table = load(d)

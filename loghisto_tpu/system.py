@@ -15,7 +15,7 @@ device-side statistics.
 
 from __future__ import annotations
 
-from typing import Mapping, Optional
+from typing import Mapping
 
 import numpy as np
 

@@ -13,8 +13,6 @@ def test_bench_main_emits_one_json_line(monkeypatch, capsys):
     import bench
 
     monkeypatch.setattr(bench, "BATCH", 1 << 14)
-    monkeypatch.setattr(bench, "STEPS", 2)
-    monkeypatch.setattr(bench, "STATS_EVERY", 1)
     monkeypatch.setattr(bench, "NUM_METRICS", 64)
     monkeypatch.setattr(bench, "BUCKET_LIMIT", 256)
     bench.main()
