@@ -155,6 +155,7 @@ def main():
     # the rest of the table (the r2_a1 capture lost scatter/matmul/sort
     # data to a single Pallas lowering rejection)
     results = {"platform": platform, "batch": n,
+               "num_buckets": cfg.num_buckets,
                "mode": mode, "rates": {}, "errors": {}}
 
     class DeviceDead(RuntimeError):

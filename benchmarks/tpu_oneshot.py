@@ -137,14 +137,44 @@ def main() -> int:
 
     stage(outdir, "host_fed_raw")(host_fed_raw)
 
-    # ---- stage 5: firehose (device-generated load, 10k metrics) ----
+    # ---- stage 5: firehose (device-generated load, 10k metrics).
+    # run_firehose is called directly so its summary dict (samples/s,
+    # intervals) LANDS IN firehose.json — the r2 captures ran the CLI
+    # and preserved only a smoke marker, leaving BASELINE configs[4]
+    # without a number (VERDICT r2 "What's weak" #5) ----
     def firehose():
         from loghisto_tpu import firehose as fh
 
-        fh.main(["--metrics", "10000", "--seconds", "10"])
-        return {"ok": True, "note": "output printed to log"}
+        class _Tee:
+            def __init__(self, *streams):
+                self.streams = streams
+
+            def write(self, s):
+                for st in self.streams:
+                    st.write(s)
+
+            def flush(self):
+                for st in self.streams:
+                    st.flush()
+
+        with open(os.path.join(outdir, "firehose_log.txt"), "w") as logf:
+            summary = fh.run_firehose(
+                num_metrics=10_000, seconds=10.0,
+                out=_Tee(sys.stdout, logf),
+            )
+        summary["log"] = "firehose_log.txt"
+        return summary
 
     stage(outdir, "firehose")(firehose)
+
+    # ---- stage 5b: per-call hot-path latency with the device tier live
+    # (VERDICT r2 item 6: the ns/op figures next to Go's 58.7ns p50) ----
+    def latency():
+        import benchmarks.latency_bench as lat
+
+        return lat.run(device=True, seconds=6.0, concurrency=100)
+
+    stage(outdir, "latency")(latency)
 
     # ---- stage 6 (LAST): device ingest path comparison table.  Runs
     # last because a kernel fault here kills the device for the rest of

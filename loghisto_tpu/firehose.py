@@ -126,7 +126,7 @@ def make_mesh_firehose_step(
     local_batch = batch // n_stream
     ingest_path = resolve_ingest_path(
         ingest_path, num_metrics, config.num_buckets,
-        mesh.devices.flat[0].platform, batch_size=local_batch,
+        mesh.devices.flat[0].platform, batch_size=local_batch, mesh=True,
     )
     generate = _make_sample_generator(num_metrics, mean, sigma)
 

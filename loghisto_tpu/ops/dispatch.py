@@ -28,16 +28,74 @@ scatter there.
 
 from __future__ import annotations
 
+import json as _json
+import os as _os
+
 # Measured crossover (device_paths.json): sort-dedup overtakes plain
 # scatter between M=256 and M=10000; the conservative switch point keeps
-# scatter through the mid range it dominates.
+# scatter through the mid range it dominates.  Baked FALLBACK — a
+# committed capture-derived table (below) overrides it.
 SORT_MIN_METRICS = 4096
+
+# Whether auto picks the fused Pallas row kernel at M=1 on TPU.  NOTE
+# (ADVICE r2): the r2 capture ranked the UNMASKED no-ids row form
+# (8.2M/s); the masked pallas_row_ingest_batch form auto actually
+# dispatches carries an extra VMEM mask stream and has not been
+# hardware-ranked yet — this default is an extrapolation until a capture
+# ranks "pallasb" (analyze_capture.py flags the comparison).
+PALLAS_SINGLE_METRIC = True
+
+# Which sort-dedup formulation auto uses at high cardinality: "sort"
+# (jnp.unique-based) or "sortscan" (sort + reverse min-scan, 3x on CPU,
+# awaiting a hardware ranking).  Capture-overridable like the rest.
+HIGH_CARDINALITY_KERNEL = "sort"
 
 # Dense one-hot matmul materializes an [N, B] one-hot per tile; the r2
 # table shows it never beating scatter on hardware at >=16 metrics, and
 # losing to the Pallas row kernel at M=1 — it remains available for
 # explicit selection but auto no longer picks it.
 MATMUL_MAX_CELLS = 1 << 21
+
+# Capture-derived threshold table (VERDICT r2 item 7): refreshing the
+# dispatch policy after a hardware capture is a committed JSON (emitted
+# by ``benchmarks/analyze_capture.py --emit-thresholds``), not a code
+# edit.  Lives next to this module; absent or unreadable -> the baked
+# constants above stand.  Stdlib-only so the module stays importable
+# without jax (analyze_capture.py depends on that).
+THRESHOLDS_FILE = _os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "dispatch_thresholds.json"
+)
+THRESHOLDS_SOURCE = "baked-in defaults"
+
+
+def _load_thresholds() -> None:
+    global SORT_MIN_METRICS, PALLAS_SINGLE_METRIC, THRESHOLDS_SOURCE
+    global HIGH_CARDINALITY_KERNEL
+    try:
+        with open(THRESHOLDS_FILE) as f:
+            table = _json.load(f)
+    except (OSError, ValueError):
+        return
+    if not isinstance(table, dict):
+        return
+    applied = False
+    smm = table.get("sort_min_metrics")
+    if isinstance(smm, int) and smm > 1:
+        SORT_MIN_METRICS = smm
+        applied = True
+    psm = table.get("pallas_single_metric")
+    if isinstance(psm, bool):
+        PALLAS_SINGLE_METRIC = psm
+        applied = True
+    hck = table.get("high_cardinality_kernel")
+    if hck in ("sort", "sortscan"):
+        HIGH_CARDINALITY_KERNEL = hck
+        applied = True
+    if applied:  # never cite a table that contributed nothing
+        THRESHOLDS_SOURCE = str(table.get("source", THRESHOLDS_FILE))
+
+
+_load_thresholds()
 
 
 def choose_ingest_path(
@@ -50,15 +108,15 @@ def choose_ingest_path(
     any measured config, so "auto" does not select it.  The Pallas row
     kernel (winner at M=1) participates via its masked
     pallas_row_ingest_batch form, which has the standard (ids, values)
-    contract.
+    contract (see PALLAS_SINGLE_METRIC note on the extrapolation).
     """
-    if platform == "tpu" and num_metrics == 1:
+    if platform == "tpu" and num_metrics == 1 and PALLAS_SINGLE_METRIC:
         # the fused Pallas row kernel wins the single-metric config
         # outright (r2 hardware table); its masked (ids, values) form
         # makes it contract-compatible with the other paths
         return "pallas"
     if platform == "tpu" and num_metrics >= SORT_MIN_METRICS:
-        return "sort"
+        return HIGH_CARDINALITY_KERNEL
     return "scatter"
 
 
@@ -69,6 +127,7 @@ def resolve_ingest_path(
     platform: str,
     guard_metrics: int | None = None,
     batch_size: int | None = None,
+    mesh: bool = False,
 ) -> str:
     """Resolve "auto" and enforce per-path shape preconditions — THE
     dispatch-guard policy, shared by TPUAggregator, the firehose, and the
@@ -83,7 +142,13 @@ def resolve_ingest_path(
     exceeds ``num_metrics`` — TPUAggregator passes its growth cap
     (max_metrics) so auto cannot pick a kernel that registry growth would
     later invalidate.  ``batch_size``, when known, guards hybrid's
-    float32 hot-head exactness bound (per-batch counts < 2^24)."""
+    float32 hot-head exactness bound (per-batch counts < 2^24); auto
+    refuses to pick "pallas" when the bound is UNKNOWN (batch_size=None)
+    — the precondition would otherwise surface as a trace-time raise
+    inside a shard_map step (ADVICE r2).  ``mesh=True`` marks a
+    shard_map-embedded resolve: auto additionally skips "pallas" there
+    (pallas_call inside shard_map is not hardware-validated; explicit
+    selection remains available as the opt-in)."""
     from loghisto_tpu.ops.sort_ingest import validate_flat_cell_shape
 
     guard = max(num_metrics, guard_metrics or 0)
@@ -92,15 +157,19 @@ def resolve_ingest_path(
         # auto never raises for a precondition: it just doesn't pick the
         # kernel the shape/batch would invalidate
         path = choose_ingest_path(num_metrics, num_buckets, platform)
-        if path == "sort":
+        if path in ("sort", "sortscan"):
             try:
-                validate_flat_cell_shape(guard, num_buckets, "sort")
+                validate_flat_cell_shape(guard, num_buckets, path)
             except ValueError:
                 path = "scatter"
-        elif path == "pallas" and (guard != 1 or batch_too_big):
+        elif path == "pallas" and (
+            guard != 1 or batch_size is None or batch_too_big or mesh
+        ):
             # registry growth can widen the row space past the
             # single-row kernel; auto must not pick it unless the cap
-            # pins M=1 (explicit "pallas" instead swaps kernels on grow)
+            # pins M=1 (explicit "pallas" instead swaps kernels on grow),
+            # the batch bound is known to satisfy the float32-exactness
+            # precondition, and the step is not shard_map-embedded
             path = "scatter"
         return path
     if path in ("sort", "sortscan", "matmul"):

@@ -99,6 +99,7 @@ def make_distributed_step(
     percentile_values,
     precision: int = PRECISION,
     ingest_path: str = "auto",
+    batch_size: int | None = None,
 ):
     """Build the jitted full aggregation step over a ("stream", "metric")
     mesh.
@@ -125,10 +126,14 @@ def make_distributed_step(
     ps = jnp.asarray(percentile_values, dtype=jnp.float32)
     # resolve dispatch OUTSIDE the traced region: choose on the global
     # metric count (duplicate-heaviness tracks global hotness), validate
-    # on it too (stricter than the local shard shape, never looser)
+    # on it too (stricter than the local shard shape, never looser).
+    # mesh=True: auto must not pick pallas inside shard_map (ADVICE r2);
+    # batch_size (the caller's per-step bound, when known) guards the
+    # float32-exactness preconditions at selection time, not trace time.
     ingest_path = resolve_ingest_path(
         ingest_path, num_metrics,
         2 * bucket_limit + 1, mesh.devices.flat[0].platform,
+        batch_size=batch_size, mesh=True,
     )
 
     def local_step(acc_local, ids, values):
@@ -440,6 +445,7 @@ class TPUAggregator:
             ingest_path = resolve_ingest_path(
                 "auto", num_metrics, config.num_buckets, platform,
                 guard_metrics=self.max_metrics, batch_size=batch_size,
+                mesh=mesh is not None,
             )
         # identity for dense-layout paths; multirow slices its lane padding
         self._finalize_acc = lambda a: a
@@ -623,6 +629,7 @@ class TPUAggregator:
             new_path = resolve_ingest_path(
                 "auto", new_m, self.config.num_buckets, platform,
                 guard_metrics=self.max_metrics, batch_size=self.batch_size,
+                mesh=self.mesh is not None,
             )
             ingest = self._make_dense_step_fn(new_path)
         acc_np = np.asarray(self._acc)

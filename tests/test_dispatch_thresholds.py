@@ -1,0 +1,140 @@
+"""VERDICT r2 item 7: the dispatch policy's thresholds are data-driven —
+a capture-derived JSON next to ops/dispatch.py overrides the baked
+constants, and benchmarks/analyze_capture.py derives that JSON from a
+hardware ranking table."""
+
+import json
+
+import pytest
+
+from loghisto_tpu.ops import dispatch
+
+
+@pytest.fixture
+def restore_dispatch_globals():
+    saved = (
+        dispatch.SORT_MIN_METRICS,
+        dispatch.PALLAS_SINGLE_METRIC,
+        dispatch.HIGH_CARDINALITY_KERNEL,
+        dispatch.THRESHOLDS_FILE,
+        dispatch.THRESHOLDS_SOURCE,
+    )
+    yield
+    (
+        dispatch.SORT_MIN_METRICS,
+        dispatch.PALLAS_SINGLE_METRIC,
+        dispatch.HIGH_CARDINALITY_KERNEL,
+        dispatch.THRESHOLDS_FILE,
+        dispatch.THRESHOLDS_SOURCE,
+    ) = saved
+
+
+def test_thresholds_file_overrides_baked_constants(
+    tmp_path, restore_dispatch_globals
+):
+    table = {
+        "source": "TPU_CAPTURE_test",
+        "sort_min_metrics": 512,
+        "high_cardinality_kernel": "sortscan",
+        "pallas_single_metric": False,
+    }
+    path = tmp_path / "dispatch_thresholds.json"
+    path.write_text(json.dumps(table))
+    dispatch.THRESHOLDS_FILE = str(path)
+    dispatch._load_thresholds()
+    assert dispatch.SORT_MIN_METRICS == 512
+    assert dispatch.THRESHOLDS_SOURCE == "TPU_CAPTURE_test"
+    # the policy immediately reflects the overrides
+    assert dispatch.choose_ingest_path(1, 8193, "tpu") == "scatter"
+    assert dispatch.choose_ingest_path(600, 8193, "tpu") == "sortscan"
+    assert dispatch.choose_ingest_path(256, 8193, "tpu") == "scatter"
+    # auto resolve validates the overridden sortscan like any sort-family
+    # pick (falls back to scatter past the int32 cell-key wrap)
+    assert dispatch.resolve_ingest_path(
+        "auto", 600, 8193, "tpu"
+    ) == "sortscan"
+    assert dispatch.resolve_ingest_path(
+        "auto", 300_000, 8193, "tpu"
+    ) == "scatter"
+
+
+def test_malformed_or_missing_thresholds_file_is_ignored(
+    tmp_path, restore_dispatch_globals
+):
+    before = (dispatch.SORT_MIN_METRICS, dispatch.PALLAS_SINGLE_METRIC,
+              dispatch.HIGH_CARDINALITY_KERNEL)
+    dispatch.THRESHOLDS_FILE = str(tmp_path / "missing.json")
+    dispatch._load_thresholds()
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    dispatch.THRESHOLDS_FILE = str(bad)
+    dispatch._load_thresholds()
+    # wrong types must not poison the policy either
+    wrong = tmp_path / "wrong.json"
+    wrong.write_text(json.dumps({
+        "sort_min_metrics": "many", "pallas_single_metric": "yes",
+        "high_cardinality_kernel": "quantum",
+    }))
+    dispatch.THRESHOLDS_FILE = str(wrong)
+    dispatch._load_thresholds()
+    assert (dispatch.SORT_MIN_METRICS, dispatch.PALLAS_SINGLE_METRIC,
+            dispatch.HIGH_CARDINALITY_KERNEL) == before
+
+
+def _derive(winners_table):
+    from benchmarks.analyze_capture import derive_thresholds
+
+    rates = {}
+    for m, ranked in winners_table.items():
+        for i, name in enumerate(ranked):
+            rates[f"{name}@{m}"] = 100.0 - i  # descending = ranked order
+    table = {"platform": "tpu", "num_buckets": 8193, "batch": 1 << 20,
+             "mode": "looped", "rates": rates}
+    winners = {m: ranked[0] for m, ranked in winners_table.items()}
+    return derive_thresholds("TPU_CAPTURE_test", table, winners)
+
+
+def test_derive_thresholds_from_r2_shaped_table():
+    # the r2 capture's shape: pallas at M=1, scatter mid, sort at 10k
+    t = _derive({
+        1: ["pallasb", "sort", "scatter"],
+        16: ["scatter", "sort"],
+        256: ["scatter", "sort"],
+        10_000: ["sort", "scatter"],
+    })
+    assert t["pallas_single_metric"] is True
+    assert t["high_cardinality_kernel"] == "sort"
+    # geometric midpoint of the 256..10000 bracket
+    assert 256 < t["sort_min_metrics"] < 10_000
+    assert t["sort_min_metrics"] == round((256 * 10_000) ** 0.5)
+
+
+def test_derive_thresholds_sort_never_wins():
+    t = _derive({1: ["scatter"], 16: ["scatter"], 10_000: ["scatter"]})
+    assert t["pallas_single_metric"] is False
+    assert t["sort_min_metrics"] >= 1 << 30  # effectively disabled
+
+
+def test_derive_thresholds_non_monotone_disables_sort():
+    # sort wins at M=16 but LOSES at the top of the measured range: a
+    # threshold would dispatch sort where the capture shows scatter
+    # winning, so the derived table disables the sort region instead
+    t = _derive({
+        16: ["sort", "scatter"],
+        256: ["scatter", "sort"],
+        10_000: ["scatter", "sort"],
+    })
+    assert t["sort_min_metrics"] >= 1 << 30
+
+
+def test_derive_thresholds_sortscan_upgrade():
+    t = _derive({16: ["scatter"], 10_000: ["sortscan", "sort"]})
+    assert t["high_cardinality_kernel"] == "sortscan"
+
+
+def test_derive_thresholds_non_tpu_refused():
+    from benchmarks.analyze_capture import derive_thresholds
+
+    assert derive_thresholds(
+        "d", {"platform": "cpu"}, {16: "scatter"}
+    ) is None

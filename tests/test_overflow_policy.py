@@ -24,7 +24,25 @@ def raw_set(histograms):
 
 # ---------------------------------------------------------------- dispatch
 
-def test_choose_ingest_path_table():
+@pytest.fixture
+def baked_thresholds():
+    """Pin the dispatch globals to the baked defaults: these tests assert
+    the FALLBACK policy, which a committed capture-derived
+    dispatch_thresholds.json legitimately overrides at import time
+    (override behavior is covered by test_dispatch_thresholds.py)."""
+    from loghisto_tpu.ops import dispatch
+
+    saved = (dispatch.SORT_MIN_METRICS, dispatch.PALLAS_SINGLE_METRIC,
+             dispatch.HIGH_CARDINALITY_KERNEL)
+    dispatch.SORT_MIN_METRICS = 4096
+    dispatch.PALLAS_SINGLE_METRIC = True
+    dispatch.HIGH_CARDINALITY_KERNEL = "sort"
+    yield
+    (dispatch.SORT_MIN_METRICS, dispatch.PALLAS_SINGLE_METRIC,
+     dispatch.HIGH_CARDINALITY_KERNEL) = saved
+
+
+def test_choose_ingest_path_table(baked_thresholds):
     # thresholds refreshed from the r2 hardware table
     # (TPU_CAPTURE_r2/device_paths.json): scatter dominates the low/mid
     # range, sort-dedup wins back high metric cardinality on TPU
@@ -35,7 +53,7 @@ def test_choose_ingest_path_table():
     assert choose_ingest_path(10_000, 8193, "cpu") == "scatter"
 
 
-def test_resolve_ingest_path_guards_sort_shape():
+def test_resolve_ingest_path_guards_sort_shape(baked_thresholds):
     from loghisto_tpu.ops.dispatch import resolve_ingest_path
 
     # auto on TPU at high cardinality picks sort when the combined int32
@@ -63,15 +81,26 @@ def test_resolve_ingest_path_guards_sort_shape():
     assert resolve_ingest_path(
         "hybrid", 100, 8193, "tpu", batch_size=1 << 20
     ) == "hybrid"
-    # pallas: auto picks it at M=1 only when the growth cap pins M=1
-    assert resolve_ingest_path("auto", 1, 8193, "tpu") == "pallas"
+    # pallas: auto picks it at M=1 only when the growth cap pins M=1 AND
+    # the batch bound is KNOWN to satisfy the float32-exactness
+    # precondition (ADVICE r2: an unknown bound would otherwise defer the
+    # 2^24 check to a trace-time raise inside the step)
     assert resolve_ingest_path(
-        "auto", 1, 8193, "tpu", guard_metrics=8
+        "auto", 1, 8193, "tpu", batch_size=1 << 20
+    ) == "pallas"
+    assert resolve_ingest_path("auto", 1, 8193, "tpu") == "scatter"
+    assert resolve_ingest_path(
+        "auto", 1, 8193, "tpu", guard_metrics=8, batch_size=1 << 20
     ) == "scatter"
     # auto must apply the same batch bound explicit pallas enforces —
     # never defer a precondition into the traced kernel
     assert resolve_ingest_path(
         "auto", 1, 8193, "tpu", batch_size=1 << 24
+    ) == "scatter"
+    # shard_map-embedded resolves never auto-pick pallas (pallas_call
+    # inside shard_map is not hardware-validated; explicit opt-in only)
+    assert resolve_ingest_path(
+        "auto", 1, 8193, "tpu", batch_size=1 << 20, mesh=True
     ) == "scatter"
     # explicit pallas demands a [1, B] starting shape
     with pytest.raises(ValueError, match="single-metric"):
