@@ -1,0 +1,167 @@
+"""Distributed-step characterization at the headline shape (VERDICT r2
+item 4): per-step cost of the full mesh aggregation step — local dense
+fold + psum merge over the stream axis + metric-sharded accumulate +
+stats — at 10k metrics x 8193 buckets with multi-million-sample batches,
+against the single-device step on the same workload.
+
+On the CI/CPU host the 8 "devices" are virtual
+(--xla_force_host_platform_device_count=8) and time-slice one core, so
+absolute samples/s is not a hardware number; the signal is the
+mesh/single per-step ratio, which isolates the extra WORK the
+distributed step adds (per-shard zero+fold, psum reduction, halo of
+out-of-shard samples) from the kernel itself.  On a real multi-chip TPU
+the same harness reports true weak scaling (run with --tpu).
+
+Usage: python benchmarks/mesh_scale.py [--metrics 10000]
+       [--bucket-limit 4096] [--batch 4194304] [--reps 3] [--out FILE]
+Prints one JSON object; importable as ``run(...)`` for tests/capture.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# must precede the jax import when run standalone
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+
+
+def _timed_step(step, acc, ids, values, reps: int) -> tuple[float, object]:
+    """Median per-step seconds, value-fetch timed (stats counts leave the
+    device each rep — block_until_ready can lie through the tunnel)."""
+    acc, stats = step(acc, ids, values)  # compile + warm
+    np.asarray(stats["counts"])
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        acc, stats = step(acc, ids, values)
+        np.asarray(stats["counts"])
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times)), acc
+
+
+def run(num_metrics: int = 10_000, bucket_limit: int = 4_096,
+        batch: int = 1 << 22, reps: int = 3,
+        shapes: list[dict] | None = None) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from loghisto_tpu.config import MetricConfig
+    from loghisto_tpu.ops.dispatch import ingest_step_fn, resolve_ingest_path
+    from loghisto_tpu.ops.stats import dense_stats
+    from loghisto_tpu.parallel.aggregator import (
+        make_distributed_step,
+        make_sharded_accumulator,
+    )
+    from loghisto_tpu.parallel.mesh import make_mesh
+
+    cfg = MetricConfig(bucket_limit=bucket_limit)
+    devs = jax.devices()
+    platform = devs[0].platform
+    ps = np.array([0.0, 0.5, 0.99, 0.9999, 1.0], dtype=np.float32)
+
+    rng = np.random.default_rng(0)
+    raw = rng.zipf(1.3, size=batch)
+    ids = jnp.asarray(((raw - 1) % num_metrics).astype(np.int32))
+    values = jnp.asarray(
+        rng.lognormal(10.0, 2.0, batch).astype(np.float32)
+    )
+
+    result = {
+        "platform": platform,
+        "n_devices": len(devs),
+        "num_metrics": num_metrics,
+        "num_buckets": cfg.num_buckets,
+        "batch": batch,
+        "reps": reps,
+        "steps": {},
+    }
+
+    # -- single-device reference step: dispatched kernel + stats --
+    path = resolve_ingest_path(
+        "auto", num_metrics, cfg.num_buckets, platform, batch_size=batch
+    )
+    kernel = ingest_step_fn(path)
+
+    @jax.jit
+    def single_step(acc, ids, values):
+        acc = kernel(acc, ids, values, cfg.bucket_limit, cfg.precision)
+        return acc, dense_stats(acc, ps, cfg.bucket_limit, cfg.precision)
+
+    acc0 = jnp.zeros((num_metrics, cfg.num_buckets), dtype=jnp.int32)
+    t_single, acc_out = _timed_step(single_step, acc0, ids, values, reps)
+    del acc_out, acc0
+    result["steps"]["single"] = {
+        "ingest_path": path,
+        "seconds_per_step": round(t_single, 4),
+        "samples_per_s": round(batch / t_single, 1),
+    }
+
+    # -- mesh steps: sweep the dp(stream) x tp(metric) spectrum --
+    n = len(devs)
+    if shapes is None:
+        shapes = []
+        metric = 1
+        while metric <= n:
+            if n % metric == 0 and num_metrics % metric == 0:
+                shapes.append({"stream": n // metric, "metric": metric})
+            metric *= 2
+    for shape in shapes:
+        mesh = make_mesh(stream=shape["stream"], metric=shape["metric"])
+        step = make_distributed_step(
+            mesh, num_metrics, cfg.bucket_limit, ps, batch_size=batch
+        )
+        acc = make_sharded_accumulator(mesh, num_metrics, cfg.num_buckets)
+        t_mesh, acc = _timed_step(step, acc, ids, values, reps)
+        del acc
+        key = f"stream{shape['stream']}xmetric{shape['metric']}"
+        result["steps"][key] = {
+            "seconds_per_step": round(t_mesh, 4),
+            "samples_per_s": round(batch / t_mesh, 1),
+            "vs_single": round(t_mesh / t_single, 3),
+        }
+    return result
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--metrics", type=int, default=10_000)
+    parser.add_argument("--bucket-limit", type=int, default=4_096)
+    parser.add_argument("--batch", type=int, default=1 << 22)
+    parser.add_argument("--reps", type=int, default=3)
+    parser.add_argument("--tpu", action="store_true",
+                        help="keep the configured (TPU) platform instead "
+                             "of forcing virtual-CPU devices")
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args(argv)
+
+    import jax
+
+    if not args.tpu:
+        jax.config.update("jax_platforms", "cpu")
+    result = run(num_metrics=args.metrics, bucket_limit=args.bucket_limit,
+                 batch=args.batch, reps=args.reps)
+    text = json.dumps(result, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -29,7 +29,12 @@ import jax.numpy as jnp
 
 @dataclasses.dataclass(frozen=True)
 class TDigestConfig:
-    capacity: int = 256  # centroid slots (static shape)
+    # 512 centroid slots (static shape): 4 KB of state.  Raised from 256
+    # with the power-law tail interpolation (VERDICT r2 item 8) — the
+    # pair holds heavy-tail p9999 error under 10% (pareto a=1.5: 41%
+    # at 256/linear -> ~6% at 512/power-law; ACCURACY.md), where the
+    # extra slots buy tail clusters the k1 scale keeps small.
+    capacity: int = 512
     # compression parameter; the k1 scale spans delta/2 clusters, so the
     # default fills ~80% of capacity (delta = 1.6 * capacity)
     delta: float = 0.0  # 0 -> derived from capacity
@@ -173,7 +178,22 @@ def merge(a, b, config: TDigestConfig = TDigestConfig()):
 
 @jax.jit
 def quantile(means, weights, qs):
-    """Interpolated quantile estimates from a digest."""
+    """Interpolated quantile estimates from a digest.
+
+    TAIL quantiles (q >= 0.9) between positive increasing centroids use a
+    POWER-LAW fit: linear in (log survival, log value) space rather than
+    (q, value) space (VERDICT r2 item 8).  Latency-like heavy tails
+    (pareto, lognormal) are convex in linear space, so the straight chord
+    between two smeared cluster means UNDERSHOOTS the quantile badly
+    exactly where t-digests are sold (41% at pareto p9999 measured in
+    r2); a power law is exact for pareto tails, and measured error drops
+    to ~6% (ACCURACY.md).  Uniform/normal tail segments are barely
+    curved in that space, so the fit is within noise of linear there.
+    BODY quantiles (q < 0.9) and segments touching zero/negative means
+    keep plain linear interpolation — geometric interpolation across a
+    sparse body segment would bias toward the low endpoint (a two-sample
+    {1, 1000} digest must report q50 ~ 500, not ~13), preserving the
+    small-N exactness contract."""
     w_sorted_idx = jnp.argsort(jnp.where(weights > 0, means, jnp.inf))
     m = means[w_sorted_idx]
     w = weights[w_sorted_idx]
@@ -190,7 +210,20 @@ def quantile(means, weights, qs):
         hi = jnp.clip(idx, 0, last)
         span = jnp.maximum(qpos[hi] - qpos[lo], 1e-30)
         frac = jnp.clip((qq - qpos[lo]) / span, 0.0, 1.0)
-        return m[lo] + frac * (m[hi] - m[lo])
+        linear = m[lo] + frac * (m[hi] - m[lo])
+        # power-law branch (guarded logs; `where` picks per-element)
+        s_lo = jnp.maximum(1.0 - qpos[lo], 1e-12)
+        s_hi = jnp.maximum(1.0 - qpos[hi], 1e-12)
+        s_q = jnp.maximum(1.0 - qq, 1e-12)
+        denom = jnp.minimum(jnp.log(s_hi) - jnp.log(s_lo), -1e-12)
+        pfrac = jnp.clip((jnp.log(s_q) - jnp.log(s_lo)) / denom, 0.0, 1.0)
+        log_lo = jnp.log(jnp.maximum(m[lo], 1e-30))
+        log_hi = jnp.log(jnp.maximum(m[hi], 1e-30))
+        powerlaw = jnp.exp(log_lo + pfrac * (log_hi - log_lo))
+        in_tail = qq >= 0.9
+        return jnp.where(
+            in_tail & (m[lo] > 0) & (m[hi] > m[lo]), powerlaw, linear
+        )
 
     return jax.vmap(one)(qs)
 

@@ -1,0 +1,78 @@
+"""VERDICT r2 item 4: the distributed step runs in CI at the HEADLINE
+shape (10k metrics x 8193 buckets), not just the toy dryrun shapes — and
+the CPU-mesh firehose produces a tracked samples/s signal (item 3).
+
+Batches here are modest (the shape is what matters: the re-shard,
+psum, and stats all operate on the full [10k, 8193] tensors); the
+multi-million-sample characterization lives in benchmarks/mesh_scale.py
+and the committed MESH_SCALE_r3.json artifact."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from loghisto_tpu.config import MetricConfig
+from loghisto_tpu.parallel.aggregator import (
+    make_distributed_step,
+    make_sharded_accumulator,
+)
+from loghisto_tpu.parallel.mesh import make_mesh
+
+NUM_METRICS = 10_000
+CFG = MetricConfig(bucket_limit=4_096)  # 8193 buckets — headline config
+BATCH = 1 << 16
+
+
+def test_distributed_step_at_headline_shape():
+    # one mesh shape in CI (the flagship stream4 x metric2); the pure
+    # stream8 shape is characterized by benchmarks/mesh_scale.py instead
+    # — two full [10k, 8193] mesh compiles would double the suite time
+    mesh = make_mesh(stream=4, metric=2)
+    ps = np.array([0.0, 0.5, 0.99, 1.0], dtype=np.float32)
+    step = make_distributed_step(
+        mesh, NUM_METRICS, CFG.bucket_limit, ps, batch_size=BATCH
+    )
+    acc = make_sharded_accumulator(mesh, NUM_METRICS, CFG.num_buckets)
+    rng = np.random.default_rng(7)
+    ids = jnp.asarray(
+        ((rng.zipf(1.3, BATCH) - 1) % NUM_METRICS).astype(np.int32)
+    )
+    values = jnp.asarray(rng.lognormal(10, 2, BATCH).astype(np.float32))
+    acc, stats = step(acc, ids, values)
+    counts = np.asarray(stats["counts"])
+    assert counts.shape == (NUM_METRICS,)
+    # exact conservation through shard offsets + psum at the real shape
+    assert int(counts.sum()) == BATCH
+    # second step folds into the same accumulator (donated) — still exact
+    acc, stats = step(acc, ids, values)
+    assert int(np.asarray(stats["counts"]).sum()) == 2 * BATCH
+    # percentile rows with samples are finite and ordered p0 <= p50 <= max
+    hot = int(np.argmax(counts))
+    pr = np.asarray(stats["percentiles"])[hot]
+    assert np.all(np.isfinite(pr))
+    assert pr[0] <= pr[1] <= pr[3]
+
+
+def test_mesh_firehose_headline_shape_reports_rate():
+    """BASELINE configs[4] signal in CI: the distributed firehose
+    (on-device generation + psum merge) at the 10k-metric shape yields a
+    samples/s figure every run — the perf-tracking hook the r2 verdict
+    asked for (absolute CPU numbers are not hardware claims)."""
+    from loghisto_tpu.firehose import run_firehose
+
+    mesh = make_mesh(stream=4, metric=2)
+    out = io.StringIO()
+    summary = run_firehose(
+        num_metrics=NUM_METRICS, batch=1 << 16, seconds=2.0,
+        interval=1.0, config=CFG, mesh=mesh, out=out,
+    )
+    assert summary["intervals"] >= 1
+    assert summary["total_samples"] >= 1 << 16
+    assert summary["samples_per_s"] > 0
+    assert "firehose:" in out.getvalue()
+    # the artifact line the CI log keeps (grep-able perf signal)
+    print(f"CI_MESH_FIREHOSE samples_per_s={summary['samples_per_s']:.0f} "
+          f"platform={summary['platform']}")
