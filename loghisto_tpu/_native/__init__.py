@@ -144,6 +144,10 @@ def _load():
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.lh_cells_drain_packed.restype = ctypes.c_int64
+        lib.lh_cells_drain_packed.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+        ]
         _lib = lib
         return _lib
 
@@ -312,10 +316,124 @@ class CellStore:
         )
         return ids_out[:got], buckets_out[:got], counts_out[:got]
 
+    def drain_packed(self) -> np.ndarray:
+        """Empty the store into one int64 [m, 2] array of (key, count)
+        rows, key = (id << 16) | (codec_bucket + 32768) — a single wire
+        transfer for the device merge.  Unpack with unpack_cells()."""
+        m = len(self)
+        out = np.empty((m, 2), dtype=np.int64)
+        got = self._lib.lh_cells_drain_packed(
+            self._handle,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        return out[:got]
+
     def close(self) -> None:
         if self._handle:
             self._lib.lh_cells_destroy(self._handle)
             self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def unpack_cells(packed: np.ndarray):
+    """Inverse of drain_packed on host: int64 [m, 2] -> (ids int32,
+    codec_buckets int32, counts int64).  The device merge kernel performs
+    the same two-op unpack in-kernel (ops.ingest.make_packed_ingest_fn)."""
+    keys = packed[:, 0]
+    ids = (keys >> 16).astype(np.int32)
+    buckets = (keys & 0xFFFF).astype(np.int32) - 32768
+    return ids, buckets, packed[:, 1]
+
+
+class ShardedCellStore:
+    """K independent CellStores, each behind its own lock, with
+    double-buffered draining (VERDICT r2 item 2: pipeline the preagg
+    transport).
+
+    * `add(ids, values)` folds into the CALLING THREAD's shard (sticky
+      round-robin assignment) — ctypes releases the GIL during the C
+      fold, so producer threads aggregate genuinely in parallel instead
+      of serializing on one table lock.
+    * `drain_packed_all()` swaps each shard's active store with its empty
+      spare under the shard lock (O(1) critical section) and scans the
+      detached table OUTSIDE the lock — producers never stall behind the
+      O(capacity) drain, and the caller can overlap the device merge of
+      shard k with the drain of shard k+1.
+
+    Cell counts stay exact: a (key -> count) entry may exist in several
+    shards; the device merge is additive, so duplicates across shards
+    cost only wire bytes (bounded by K, worth it for lock-free-ish
+    ingest)."""
+
+    def __init__(self, bucket_limit: int, precision: int = 100,
+                 num_shards: int | None = None,
+                 initial_capacity: int = 1 << 14):
+        if num_shards is None:
+            num_shards = min(8, (os.cpu_count() or 1))
+        self.num_shards = max(1, int(num_shards))
+        self._locks = [threading.Lock() for _ in range(self.num_shards)]
+        self._active = [
+            CellStore(bucket_limit, precision, initial_capacity)
+            for _ in range(self.num_shards)
+        ]
+        self._spare = [
+            CellStore(bucket_limit, precision, initial_capacity)
+            for _ in range(self.num_shards)
+        ]
+        # only one drainer manipulates the spare set at a time
+        self._drain_lock = threading.Lock()
+        self._tl = threading.local()
+        self._assign = 0
+
+    def _shard_idx(self) -> int:
+        idx = getattr(self._tl, "idx", None)
+        if idx is None:
+            idx = self._assign % self.num_shards
+            self._assign += 1  # benign race: placement heuristic only
+            self._tl.idx = idx
+        return idx
+
+    def __len__(self) -> int:
+        # racy sum (watermark heuristic, not an invariant)
+        return sum(len(s) for s in self._active)
+
+    def add(self, ids: np.ndarray, values: np.ndarray) -> int:
+        """Fold a batch into this thread's shard.  Same exactness contract
+        as CellStore.add: returns the consumed prefix length."""
+        i = self._shard_idx()
+        with self._locks[i]:
+            return self._active[i].add(ids, values)
+
+    def drain_packed_all(self) -> np.ndarray:
+        """Drain every shard; returns one int64 [m, 2] packed array.
+        Per shard: O(1) swap under the shard lock, table scan unlocked."""
+        with self._drain_lock:
+            parts = []
+            for i in range(self.num_shards):
+                with self._locks[i]:
+                    self._active[i], self._spare[i] = (
+                        self._spare[i], self._active[i]
+                    )
+                detached = self._spare[i]  # old active; drained unlocked
+                part = detached.drain_packed()
+                if len(part):
+                    parts.append(part)
+        if not parts:
+            return np.empty((0, 2), dtype=np.int64)
+        return np.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    def drain(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Compatibility form of drain_packed_all (ids, buckets, counts)."""
+        return unpack_cells(self.drain_packed_all())
+
+    def close(self) -> None:
+        for s in self._active + self._spare:
+            s.close()
 
     def __del__(self):
         try:

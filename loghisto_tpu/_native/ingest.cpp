@@ -274,6 +274,28 @@ int64_t lh_cells_drain(void* store, int32_t* ids_out, int32_t* buckets_out,
   return m;
 }
 
+// Copy out every cell as interleaved [key, count] int64 pairs and clear
+// the table (capacity retained).  key = (id << 16) | (bucket + 32768) —
+// the hash key itself, so draining is a straight copy; the device (or
+// numpy) unpacks with two vector ops (key >> 16, (key & 0xFFFF) - 32768).
+// One packed array means ONE host->device transfer per merge chunk
+// instead of three — per-transfer latency is the dominant wire cost on a
+// thin tunnel link.  out must hold 2 * lh_cells_size(store) entries.
+int64_t lh_cells_drain_packed(void* store, int64_t* out) {
+  CellStore* cs = static_cast<CellStore*>(store);
+  int64_t m = 0;
+  for (CellSlot& s : cs->table) {
+    if (s.key == 0) continue;
+    out[2 * m] = static_cast<int64_t>(s.key);
+    out[2 * m + 1] = s.count;
+    s.key = 0;
+    s.count = 0;
+    ++m;
+  }
+  cs->used = 0;
+  return m;
+}
+
 // Dense accumulate on host: the CPU fallback / verification twin of the
 // device scatter-add kernel. acc is uint32[num_metrics][2*bucket_limit+1].
 void lh_accumulate_dense(const int32_t* ids, const double* values, int64_t n,

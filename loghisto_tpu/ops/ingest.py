@@ -83,6 +83,29 @@ def make_weighted_ingest_fn(bucket_limit: int):
     return ingest
 
 
+def make_packed_ingest_fn(bucket_limit: int):
+    """Weighted cell merge from ONE int32 [n, 3] array of
+    (id, codec_bucket, count) columns — the cell store's packed drain
+    (ingest.cpp lh_cells_drain_packed) converted host-side by
+    aggregator._merge_packed_locked.  One host->device transfer per
+    merge chunk instead of three parallel arrays.  int32 END TO END on
+    purpose: this repo never enables jax_enable_x64, so an int64 wire
+    array would be silently canonicalized to int32 — with the earlier
+    (id << 16) key format that truncation corrupted every metric id
+    >= 2^15 (registry growth takes the default 10k config to 80k rows).
+    Padding rows use id -1, which sanitize_ids drops like every other
+    kernel; callers route counts >= 2^30 to the exact host spill first,
+    so the int32 count column cannot overflow."""
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def ingest(acc, packed):
+        ids = packed[:, 0]
+        idx = jnp.clip(packed[:, 1], -bucket_limit, bucket_limit) + bucket_limit
+        return acc.at[sanitize_ids(ids), idx].add(packed[:, 2], mode="drop")
+
+    return ingest
+
+
 @functools.partial(jax.jit, donate_argnums=0)
 def merge_accumulators(acc: jnp.ndarray, other: jnp.ndarray) -> jnp.ndarray:
     """Elementwise histogram merge — the fundamental mergeability property

@@ -405,18 +405,28 @@ class TPUAggregator:
                 )
         self.transport = transport
         self._cell_store = None
-        # guards the cell store; may nest _dev_lock INSIDE it (never the
-        # reverse), so device holders can't deadlock cell folding
-        self._cells_lock = threading.Lock()
         # watermark: ship cells to the device mid-interval once the host
         # store holds this many (bounds host memory at ~16B/cell)
         self.max_host_cells = 1 << 22
         if transport == "preagg":
             from loghisto_tpu import _native as _nat
 
-            self._cell_store = _nat.CellStore(
+            # Sharded + double-buffered (VERDICT r2 item 2): producers
+            # fold into per-thread shards at record time (the C fold runs
+            # with the GIL released, so writer threads aggregate in
+            # parallel), and draining swaps buffers per shard so the
+            # O(capacity) scan never blocks ingest.
+            self._cell_store = _nat.ShardedCellStore(
                 config.bucket_limit, config.precision
             )
+            if self._native_buf is not None:
+                import logging
+
+                logging.getLogger("loghisto_tpu").info(
+                    "preagg transport folds samples into the cell store "
+                    "at record time; the native staging buffer is unused"
+                )
+                self._native_buf = None
 
         self.mesh = mesh
         if mesh is not None:
@@ -506,6 +516,11 @@ class TPUAggregator:
             )
         self.ingest_path = ingest_path
         self._weighted_ingest = make_weighted_ingest_fn(config.bucket_limit)
+        if self._cell_store is not None:
+            from loghisto_tpu.ops.ingest import make_packed_ingest_fn
+
+            # preagg wire format: one int64 [n, 2] array per merge chunk
+            self._packed_ingest = make_packed_ingest_fn(config.bucket_limit)
         self._stats_fn = jax.jit(
             functools.partial(
                 dense_stats,
@@ -679,6 +694,14 @@ class TPUAggregator:
         values = np.asarray(values, dtype=np.float32)
         if ids.shape != values.shape:
             raise ValueError("ids and values must have the same shape")
+        if self._cell_store is not None:
+            # preagg direct fold (VERDICT r2 item 2): samples are touched
+            # ONCE — compressed + deduped into this thread's cell shard
+            # right here, with the GIL released inside the C fold.  No
+            # staging lists, no concatenate, no second pass at flush; the
+            # device sees one packed ship per interval (or watermark).
+            self._preagg_record(ids, values)
+            return
         if self._native_buf is not None:
             accepted = self._native_buf.record_batch(
                 ids, values.astype(np.float64)
@@ -752,6 +775,15 @@ class TPUAggregator:
         cooldown-gated so a down device costs one attempt per
         retry_cooldown, not one per record.  `force=True` (used by
         collect()) bypasses the cooldown."""
+        if self._cell_store is not None:
+            # preagg: samples were folded at record time; flushing means
+            # shipping the deduped cells.  Mid-interval ships happen only
+            # past the watermark (the wire carries each interval's unique
+            # cells once); `force` (collect/checkpoint) always ships.
+            if not force and len(self._cell_store) < self.max_host_cells:
+                return
+            self._ship_packed(self._cell_store.drain_packed_all())
+            return
         if self._native_buf is not None:
             with self._lock:
                 self._native_staged = 0
@@ -767,14 +799,11 @@ class TPUAggregator:
                 ids = values = None
             elif (
                 not force
-                and self.transport == "raw"
                 and time.monotonic() < self._device_down_until
             ):
                 # _device_down_until is written under _dev_lock; this read
                 # is a benign race (cooldown is a heuristic, not an
-                # invariant).  Only the raw path gates here — the preagg
-                # fold below is host-only work and must keep absorbing
-                # while the device cools down.
+                # invariant)
                 return  # device cooling down; keep buffering
             else:
                 ids = np.concatenate(self._pending_ids)
@@ -783,9 +812,6 @@ class TPUAggregator:
                 self._pending_count = 0
         # staging lock released: producers keep appending while the device
         # loop below runs (non-blocking flush, SURVEY.md §7 hard part (a))
-        if self.transport == "preagg":
-            self._flush_preagg(ids, values, force)
-            return
         if ids is None:
             return
         n = len(ids)
@@ -859,68 +885,91 @@ class TPUAggregator:
                 self._pending_count += n - retry_off
                 self._bound_pending_locked()
 
-    def _flush_preagg(
-        self,
-        ids: Optional[np.ndarray],
-        values: Optional[np.ndarray],
-        force: bool,
-    ) -> None:
-        """Preagg transport: fold the drained batch into the persistent
-        host cell store (native hash, the same codec bit-for-bit as the
-        device kernel).  The device sees traffic only on `force` (interval
-        boundaries: collect/checkpoint) or when the store crosses the
-        max_host_cells watermark — so the wire carries each interval's
-        UNIQUE cells once, however many samples they absorbed, and a thin
-        host->device link no longer caps sample throughput.  On device
-        failure the cells fold into the host int64 spill — they are
-        already exact aggregates, so nothing needs a retry queue."""
-        with self._cells_lock:
-            if ids is not None:
-                consumed = self._cell_store.add(ids, values)
-                if consumed < len(ids):
-                    # table could not grow: the consumed prefix is folded
-                    # exactly once, so ship everything held (drained
-                    # table keeps its capacity, now at low load) and
-                    # retry ONLY the remainder — no double count
-                    self._ship_cells(*self._cell_store.drain())
-                    rest = self._cell_store.add(
-                        ids[consumed:], values[consumed:]
-                    )
-                    if consumed + rest < len(ids):
-                        dropped = len(ids) - consumed - rest
-                        with self._shed_lock:
-                            self._shed_samples += dropped
-                        import logging
+    def _preagg_record(self, ids: np.ndarray, values: np.ndarray) -> None:
+        """Fold one batch into the calling thread's cell shard (the preagg
+        hot path — native hash, the same codec bit-for-bit as the device
+        kernel).  The device sees traffic only on force-flush (interval
+        boundaries: collect/checkpoint) or past the max_host_cells
+        watermark — so the wire carries each interval's UNIQUE cells
+        once, however many samples they absorbed, and a thin host->device
+        link no longer caps sample throughput.  On device failure the
+        cells fold into the host int64 spill — they are already exact
+        aggregates, so nothing needs a retry queue."""
+        consumed = self._cell_store.add(ids, values)
+        if consumed < len(ids):
+            # shard table could not grow: the consumed prefix is folded
+            # exactly once, so ship everything held (drained tables keep
+            # their capacity, now at low load) and retry ONLY the
+            # remainder — no double count
+            self._ship_packed(self._cell_store.drain_packed_all())
+            rest = self._cell_store.add(ids[consumed:], values[consumed:])
+            if consumed + rest < len(ids):
+                dropped = len(ids) - consumed - rest
+                with self._shed_lock:
+                    self._shed_samples += dropped
+                import logging
 
-                        logging.getLogger("loghisto_tpu").error(
-                            "cell store cannot grow even after draining; "
-                            "shed %d samples", dropped,
-                        )
-            if not force and len(self._cell_store) < self.max_host_cells:
-                return
-            uids, ubuckets, uweights = self._cell_store.drain()
-        self._ship_cells(uids, ubuckets, uweights)
+                logging.getLogger("loghisto_tpu").error(
+                    "cell store cannot grow even after draining; "
+                    "shed %d samples", dropped,
+                )
+        if len(self._cell_store) >= self.max_host_cells:
+            self.flush()
 
-    def _ship_cells(
-        self,
-        uids: np.ndarray,
-        ubuckets: np.ndarray,
-        uweights: np.ndarray,
-    ) -> None:
-        if not len(uids):
+    def _ship_packed(self, packed: np.ndarray) -> None:
+        """Merge drained packed cells into the device accumulator (one
+        int64 [m, 2] wire array; ingest.cpp lh_cells_drain_packed)."""
+        if not len(packed):
             return
-        ubuckets64 = ubuckets.astype(np.int64)
         with self._dev_lock:
             try:
-                self._merge_cells_locked(uids, ubuckets64, uweights)
+                self._merge_packed_locked(packed)
             except Exception:
                 # chunk-dispatch failures are handled (and partially
-                # spilled) inside _merge_cells_locked; reaching here means
-                # the merge failed BEFORE applying any cell (e.g. the
-                # spill fold's device read) — spilling the full set is
-                # exact, not a double count
+                # spilled) inside _merge_packed_locked; reaching here
+                # means the merge failed BEFORE applying any cell (e.g.
+                # the spill fold's device read) — spilling the full set
+                # is exact, not a double count
                 self._on_device_failure_locked()
-                self._spill_add_cells_locked(uids, ubuckets64, uweights)
+                self._spill_add_packed_locked(packed)
+
+    def _spill_add_packed_locked(self, packed: np.ndarray) -> None:
+        from loghisto_tpu._native import unpack_cells
+
+        uids, ubuckets, uweights = unpack_cells(packed)
+        self._spill_add_cells_locked(
+            uids, ubuckets.astype(np.int64), uweights
+        )
+
+    def _merge_packed_locked(self, packed: np.ndarray) -> None:
+        """Packed twin of _merge_cells_locked: same spill guarantees and
+        per-chunk accounting, one device transfer per chunk.  Caller
+        holds _dev_lock."""
+        n = len(packed)
+        weights = packed[:, 1]
+        total = int(weights.sum())
+        if (
+            self._interval_ingested + total >= self.spill_threshold
+            or (n and int(weights.max()) >= 1 << 30)
+        ):
+            self._spill_fold_locked()
+            self._spill_add_packed_locked(packed)
+            return
+        for off in range(0, n, _MERGE_CHUNK):
+            take = min(_MERGE_CHUNK, n - off)
+            pad = np.empty((_MERGE_CHUNK, 2), dtype=np.int64)
+            pad[:, 0] = -1  # id -1 after the shift: dropped by the kernel
+            pad[:, 1] = 0
+            pad[:take] = packed[off:off + take]
+            try:
+                self._acc = self._packed_ingest(self._acc, pad)
+            except Exception:
+                self._on_device_failure_locked()
+                self._spill_add_packed_locked(packed[off:])
+                return
+            # success-only reset, mirroring the raw flush loop
+            self._device_down_until = 0.0
+            self._interval_ingested += int(weights[off:off + take].sum())
 
     def _on_device_failure_locked(self) -> None:
         """Device-failure bookkeeping (caller holds _dev_lock, and must

@@ -172,3 +172,105 @@ def test_cell_store_growth_past_initial_capacity():
     assert int(uwts.sum()) == 100_000
     assert len(uids) > 1024  # grew well past the initial table
     store.close()
+
+
+def test_cell_store_packed_drain_matches_drain():
+    """drain_packed carries exactly the cells drain would, as one int64
+    [m, 2] array; unpack_cells inverts the key packing (incl. negative
+    codec buckets)."""
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, 3000, 50_000).astype(np.int32)
+    vals = np.concatenate([
+        rng.lognormal(8, 3, 25_000), -rng.lognormal(5, 2, 25_000)
+    ]).astype(np.float32)
+    a = _native.CellStore(bucket_limit=4096)
+    b = _native.CellStore(bucket_limit=4096)
+    assert a.add(ids, vals) == len(ids)
+    assert b.add(ids, vals) == len(ids)
+    uids, ubkts, uwts = a.drain()
+    packed = b.drain_packed()
+    assert packed.shape == (len(uids), 2)
+    pids, pbkts, pwts = _native.unpack_cells(packed)
+    want = dict(zip(zip(uids.tolist(), ubkts.tolist()), uwts.tolist()))
+    got = dict(zip(zip(pids.tolist(), pbkts.tolist()), pwts.tolist()))
+    assert got == want
+    assert (pbkts < 0).any() and (pbkts > 0).any()  # both signs exercised
+    a.close(); b.close()
+
+
+def test_sharded_cell_store_concurrent_exactness():
+    """VERDICT r2 item 2: per-thread shards + double-buffered drains.
+    Writer threads fold concurrently while a drainer repeatedly swaps
+    buffers; no sample may be lost or double counted."""
+    import threading
+
+    store = _native.ShardedCellStore(bucket_limit=1024, num_shards=4)
+    rng = np.random.default_rng(11)
+    per_thread = 40
+    batch = 2_000
+    drained = []
+    drained_lock = threading.Lock()
+
+    def writer(seed):
+        r = np.random.default_rng(seed)
+        for _ in range(per_thread):
+            ids = r.integers(0, 500, batch).astype(np.int32)
+            vals = r.lognormal(4, 1, batch).astype(np.float32)
+            assert store.add(ids, vals) == batch
+
+    def drainer(stop):
+        while not stop.is_set():
+            p = store.drain_packed_all()
+            if len(p):
+                with drained_lock:
+                    drained.append(p)
+
+    stop = threading.Event()
+    dt = threading.Thread(target=drainer, args=(stop,))
+    writers = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+    dt.start()
+    for w in writers:
+        w.start()
+    for w in writers:
+        w.join()
+    stop.set()
+    dt.join()
+    drained.append(store.drain_packed_all())
+    total = sum(int(p[:, 1].sum()) for p in drained if len(p))
+    assert total == 4 * per_thread * batch
+    store.close()
+
+
+def test_packed_ingest_kernel_matches_weighted():
+    """make_packed_ingest_fn (one-array wire format) is bit-identical to
+    make_weighted_ingest_fn (three arrays), and drops the [-1, 0]
+    padding rows."""
+    import jax.numpy as jnp
+
+    from loghisto_tpu.ops.ingest import (
+        make_packed_ingest_fn,
+        make_weighted_ingest_fn,
+    )
+
+    bl = 256
+    rng = np.random.default_rng(5)
+    m = 64
+    ids = rng.integers(0, m, 500).astype(np.int64)
+    buckets = rng.integers(-bl, bl + 1, 500).astype(np.int64)
+    weights = rng.integers(1, 1000, 500).astype(np.int64)
+    packed = np.empty((512, 2), dtype=np.int64)
+    packed[:, 0] = -1  # pad rows: dropped
+    packed[:, 1] = 0
+    packed[:500, 0] = (ids << 16) | (buckets + 32768)
+    packed[:500, 1] = weights
+
+    acc0 = jnp.zeros((m, 2 * bl + 1), dtype=jnp.int32)
+    got = np.asarray(make_packed_ingest_fn(bl)(acc0, jnp.asarray(packed)))
+    acc1 = jnp.zeros((m, 2 * bl + 1), dtype=jnp.int32)
+    want = np.asarray(make_weighted_ingest_fn(bl)(
+        acc1, jnp.asarray(ids.astype(np.int32)),
+        jnp.asarray(buckets.astype(np.int32)),
+        jnp.asarray(weights.astype(np.int32)),
+    ))
+    np.testing.assert_array_equal(got, want)
+    assert got.sum() == weights.sum()
