@@ -146,7 +146,7 @@ def _load():
         ]
         lib.lh_cells_drain_packed.restype = ctypes.c_int64
         lib.lh_cells_drain_packed.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
         ]
         _lib = lib
         return _lib
@@ -317,16 +317,27 @@ class CellStore:
         return ids_out[:got], buckets_out[:got], counts_out[:got]
 
     def drain_packed(self) -> np.ndarray:
-        """Empty the store into one int64 [m, 2] array of (key, count)
-        rows, key = (id << 16) | (codec_bucket + 32768) — a single wire
-        transfer for the device merge.  Unpack with unpack_cells()."""
-        m = len(self)
-        out = np.empty((m, 2), dtype=np.int64)
-        got = self._lib.lh_cells_drain_packed(
-            self._handle,
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        )
-        return out[:got]
+        """Empty the store into one int32 [m, 3] array of
+        (id, codec_bucket, count) rows — a single wire transfer for the
+        device merge (ops.ingest.make_packed_ingest_fn), int32 end to
+        end so no-x64 JAX canonicalization cannot truncate it.  A cell
+        whose count exceeds the C side's 2^30-1 cap is emitted as
+        multiple rows across passes (the drain loop below); histogram
+        merges are additive, so split rows stay exact."""
+        parts = []
+        while True:
+            m = len(self)
+            if m == 0:
+                break
+            out = np.empty((m, 3), dtype=np.int32)
+            got = self._lib.lh_cells_drain_packed(
+                self._handle,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            )
+            parts.append(out[:got])
+        if not parts:
+            return np.empty((0, 3), dtype=np.int32)
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
     def close(self) -> None:
         if self._handle:
@@ -341,13 +352,14 @@ class CellStore:
 
 
 def unpack_cells(packed: np.ndarray):
-    """Inverse of drain_packed on host: int64 [m, 2] -> (ids int32,
-    codec_buckets int32, counts int64).  The device merge kernel performs
-    the same two-op unpack in-kernel (ops.ingest.make_packed_ingest_fn)."""
-    keys = packed[:, 0]
-    ids = (keys >> 16).astype(np.int32)
-    buckets = (keys & 0xFFFF).astype(np.int32) - 32768
-    return ids, buckets, packed[:, 1]
+    """Split the int32 [m, 3] (id, codec_bucket, count) wire array into
+    (ids int32, codec_buckets int32, counts int64) columns — the host
+    twin of the column reads in ops.ingest.make_packed_ingest_fn."""
+    return (
+        packed[:, 0],
+        packed[:, 1],
+        packed[:, 2].astype(np.int64),
+    )
 
 
 class ShardedCellStore:
@@ -410,7 +422,7 @@ class ShardedCellStore:
             return self._active[i].add(ids, values)
 
     def drain_packed_all(self) -> np.ndarray:
-        """Drain every shard; returns one int64 [m, 2] packed array.
+        """Drain every shard; returns one int32 [m, 3] packed array.
         Per shard: O(1) swap under the shard lock, table scan unlocked."""
         with self._drain_lock:
             parts = []
@@ -424,7 +436,7 @@ class ShardedCellStore:
                 if len(part):
                     parts.append(part)
         if not parts:
-            return np.empty((0, 2), dtype=np.int64)
+            return np.empty((0, 3), dtype=np.int32)
         return np.concatenate(parts) if len(parts) > 1 else parts[0]
 
     def drain(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:

@@ -274,25 +274,42 @@ int64_t lh_cells_drain(void* store, int32_t* ids_out, int32_t* buckets_out,
   return m;
 }
 
-// Copy out every cell as interleaved [key, count] int64 pairs and clear
-// the table (capacity retained).  key = (id << 16) | (bucket + 32768) —
-// the hash key itself, so draining is a straight copy; the device (or
-// numpy) unpacks with two vector ops (key >> 16, (key & 0xFFFF) - 32768).
-// One packed array means ONE host->device transfer per merge chunk
-// instead of three — per-transfer latency is the dominant wire cost on a
-// thin tunnel link.  out must hold 2 * lh_cells_size(store) entries.
-int64_t lh_cells_drain_packed(void* store, int64_t* out) {
+// Copy out every cell as interleaved [id, codec_bucket, count] int32
+// triples and clear the table (capacity retained).  int32 END TO END on
+// purpose: the device merge never enables jax_enable_x64, so an int64
+// wire array would be silently canonicalized to int32 — with the earlier
+// (id << 16) key format that truncation corrupted every id >= 2^15.
+// One packed array still means ONE host->device transfer per merge
+// chunk instead of three — per-transfer latency is the dominant wire
+// cost on a thin tunnel link.  out must hold 3 * lh_cells_size(store)
+// entries.  A cell whose int64 count exceeds LH_PACKED_COUNT_CAP is
+// emitted capped and LEFT IN THE TABLE with the remainder — the caller
+// loops until lh_cells_size reaches 0 (one pass in any realistic run;
+// the cap keeps every emitted row < 2^30, below the aggregator's int32
+// accumulator spill threshold).
+static const int64_t LH_PACKED_COUNT_CAP = (1 << 30) - 1;
+
+int64_t lh_cells_drain_packed(void* store, int32_t* out) {
   CellStore* cs = static_cast<CellStore*>(store);
   int64_t m = 0;
+  int64_t remaining = 0;
   for (CellSlot& s : cs->table) {
     if (s.key == 0) continue;
-    out[2 * m] = static_cast<int64_t>(s.key);
-    out[2 * m + 1] = s.count;
-    s.key = 0;
-    s.count = 0;
+    int64_t c = s.count;
+    int64_t emit = c > LH_PACKED_COUNT_CAP ? LH_PACKED_COUNT_CAP : c;
+    out[3 * m] = static_cast<int32_t>(s.key >> 16);
+    out[3 * m + 1] = static_cast<int32_t>(s.key & 0xFFFF) - 32768;
+    out[3 * m + 2] = static_cast<int32_t>(emit);
     ++m;
+    if (c > emit) {
+      s.count = c - emit;
+      ++remaining;
+    } else {
+      s.key = 0;
+      s.count = 0;
+    }
   }
-  cs->used = 0;
+  cs->used = remaining;
   return m;
 }
 

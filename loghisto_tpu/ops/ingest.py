@@ -99,6 +99,14 @@ def make_packed_ingest_fn(bucket_limit: int):
 
     @functools.partial(jax.jit, donate_argnums=0)
     def ingest(acc, packed):
+        # Trace-time contract check: shapes are static under jit, and a
+        # 2-column array would NOT fail the [:, 2] read below (static
+        # OOB gathers clamp) — it would silently misread columns.
+        if packed.ndim != 2 or packed.shape[1] != 3:
+            raise ValueError(
+                f"packed must be [n, 3] (id, bucket, count); "
+                f"got {packed.shape}"
+            )
         ids = packed[:, 0]
         idx = jnp.clip(packed[:, 1], -bucket_limit, bucket_limit) + bucket_limit
         return acc.at[sanitize_ids(ids), idx].add(packed[:, 2], mode="drop")

