@@ -120,6 +120,10 @@ def run(num_metrics: int = 10_000, bucket_limit: int = 4_096,
             if n % metric == 0 and num_metrics % metric == 0:
                 shapes.append({"stream": n // metric, "metric": metric})
             metric *= 2
+    from loghisto_tpu.parallel.aggregator import (
+        make_interval_distributed_step,
+    )
+
     for shape in shapes:
         mesh = make_mesh(stream=shape["stream"], metric=shape["metric"])
         step = make_distributed_step(
@@ -133,6 +137,46 @@ def run(num_metrics: int = 10_000, bucket_limit: int = 4_096,
             "seconds_per_step": round(t_mesh, 4),
             "samples_per_s": round(batch / t_mesh, 1),
             "vs_single": round(t_mesh / t_single, 3),
+        }
+
+        # -- interval-amortized path (VERDICT r3 item 3): collective-free
+        # per-batch folds, ONE psum at collect.  Report the per-batch
+        # ingest cost (the steady-state number the amortization buys) and
+        # the once-per-interval collect cost separately.
+        ingest, collect, make_partial = make_interval_distributed_step(
+            mesh, num_metrics, cfg.bucket_limit, ps, batch_size=batch
+        )
+        partial = ingest(make_partial(), ids, values)  # compile + warm
+        jax.block_until_ready(partial)
+        t_in = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            partial = ingest(partial, ids, values)
+            jax.block_until_ready(partial)
+            t_in.append(time.perf_counter() - t0)
+        t_ingest = float(np.median(t_in))
+        acc = make_sharded_accumulator(mesh, num_metrics, cfg.num_buckets)
+        acc, partial, stats = collect(acc, partial)  # compile + warm
+        np.asarray(stats["counts"])
+        t_col = []
+        for _ in range(reps):
+            partial = ingest(partial, ids, values)
+            jax.block_until_ready(partial)
+            t0 = time.perf_counter()
+            acc, partial, stats = collect(acc, partial)
+            np.asarray(stats["counts"])
+            t_col.append(time.perf_counter() - t0)
+        t_collect = float(np.median(t_col))
+        del acc, partial, stats
+        result["steps"][key + "_interval"] = {
+            "ingest_seconds_per_batch": round(t_ingest, 4),
+            "collect_seconds": round(t_collect, 4),
+            "ingest_samples_per_s": round(batch / t_ingest, 1),
+            "ingest_vs_single": round(t_ingest / t_single, 3),
+            # effective per-batch cost at 10 batches/interval
+            "per_batch_at_10_vs_single": round(
+                (t_ingest + t_collect / 10) / t_single, 3
+            ),
         }
     return result
 

@@ -4,6 +4,7 @@ TPUAggregator runtime, and multi-host initialization."""
 from loghisto_tpu.parallel.aggregator import (
     TPUAggregator,
     make_distributed_step,
+    make_interval_distributed_step,
     make_sharded_accumulator,
 )
 from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS, make_mesh
@@ -13,6 +14,7 @@ __all__ = [
     "STREAM_AXIS",
     "TPUAggregator",
     "make_distributed_step",
+    "make_interval_distributed_step",
     "make_mesh",
     "make_sharded_accumulator",
 ]

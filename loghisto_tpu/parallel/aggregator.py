@@ -168,6 +168,121 @@ def make_sharded_accumulator(
     )
 
 
+def make_interval_distributed_step(
+    mesh: Mesh,
+    num_metrics: int,
+    bucket_limit: int,
+    percentile_values,
+    precision: int = PRECISION,
+    ingest_path: str = "auto",
+    batch_size: int | None = None,
+):
+    """Interval-amortized distributed aggregation (VERDICT r3 item 3).
+
+    ``make_distributed_step`` psums the full dense [rows, buckets]
+    histogram across the stream axis EVERY batch — MESH_SCALE_r3.json
+    measured that collective at 7.8x a single-device step for pure
+    stream sharding.  But histogram merges are associative: nothing
+    requires the cross-stream reduction before the interval boundary.
+    Here each device folds batches into its own (stream, metric) partial
+    with ZERO collectives, and the stream-axis psum runs once per
+    ``collect`` — with B batches/interval the collective amortizes to
+    1/B of the per-batch design's volume.
+
+    Returns (ingest, collect, make_partial):
+
+      make_partial() -> int32 [n_stream, num_metrics, num_buckets],
+          sharded P(stream, metric, None) — each device owns one
+          [1, rows_per_shard, num_buckets] block, so the partial costs
+          one accumulator's worth of HBM per device, not n_stream.
+      ingest(partial, ids, values) -> partial
+          Collective-free per-batch fold (donated partial; ids/values
+          stream-sharded like the per-batch design).
+      collect(acc, partial) -> (acc, fresh_partial, stats)
+          One psum over the stream axis, fold into the metric-sharded
+          accumulator, stats on the merged rows; returns a zeroed
+          partial so the caller just rebinds both carries.
+    """
+    n_metric = mesh.shape[METRIC_AXIS]
+    n_stream = mesh.shape[STREAM_AXIS]
+    if num_metrics % n_metric:
+        raise ValueError(
+            f"num_metrics={num_metrics} not divisible by metric axis "
+            f"size {n_metric}"
+        )
+    rows_per_shard = num_metrics // n_metric
+    ps = jnp.asarray(percentile_values, dtype=jnp.float32)
+    ingest_path = resolve_ingest_path(
+        ingest_path, num_metrics,
+        2 * bucket_limit + 1, mesh.devices.flat[0].platform,
+        batch_size=batch_size, mesh=True,
+    )
+
+    def local_ingest(partial_local, ids, values):
+        from loghisto_tpu.ops.dispatch import ingest_step_fn
+
+        shard = jax.lax.axis_index(METRIC_AXIS)
+        local_ids = sanitize_ids(ids - shard * rows_per_shard)
+        folded = ingest_step_fn(ingest_path)(
+            partial_local[0], local_ids, values, bucket_limit, precision
+        )
+        return folded[None]
+
+    ingest = jax.jit(
+        jax.shard_map(
+            local_ingest,
+            mesh=mesh,
+            in_specs=(
+                P(STREAM_AXIS, METRIC_AXIS, None),
+                P(STREAM_AXIS),
+                P(STREAM_AXIS),
+            ),
+            out_specs=P(STREAM_AXIS, METRIC_AXIS, None),
+        ),
+        donate_argnums=0,
+    )
+
+    def local_collect(acc_local, partial_local):
+        merged = jax.lax.psum(partial_local[0], STREAM_AXIS)
+        acc_local = acc_local + merged
+        stats = dense_stats(acc_local, ps, bucket_limit, precision)
+        return acc_local, jnp.zeros_like(partial_local), stats
+
+    stats_specs = {
+        "counts": P(METRIC_AXIS),
+        "sums": P(METRIC_AXIS),
+        "percentiles": P(METRIC_AXIS, None),
+    }
+    collect = jax.jit(
+        jax.shard_map(
+            local_collect,
+            mesh=mesh,
+            in_specs=(
+                P(METRIC_AXIS, None),
+                P(STREAM_AXIS, METRIC_AXIS, None),
+            ),
+            out_specs=(
+                P(METRIC_AXIS, None),
+                P(STREAM_AXIS, METRIC_AXIS, None),
+                stats_specs,
+            ),
+        ),
+        donate_argnums=(0, 1),
+    )
+
+    def make_partial() -> jnp.ndarray:
+        sharding = NamedSharding(mesh, P(STREAM_AXIS, METRIC_AXIS, None))
+        return jax.device_put(
+            jnp.zeros(
+                (n_stream, num_metrics, 2 * bucket_limit + 1),
+                dtype=jnp.int32,
+            ),
+            sharding,
+        )
+
+    return ingest, collect, make_partial
+
+
 class TPUAggregator:
     """Device-tier metric engine (the reference has no equivalent; this is
     the TPU execution backend the north star adds behind the subscription

@@ -56,6 +56,57 @@ def test_distributed_step_at_headline_shape():
     assert pr[0] <= pr[1] <= pr[3]
 
 
+def test_interval_mode_exact_and_matches_per_batch():
+    """VERDICT r3 item 3: the interval-amortized path (collective-free
+    per-batch folds, one psum per collect) must be bit-identical to the
+    per-batch-psum design AND to a single-device fold, at the headline
+    shape.  Exercises pure stream sharding — the shape whose per-batch
+    psum cost motivated the amortization."""
+    from loghisto_tpu.parallel.aggregator import (
+        make_interval_distributed_step,
+    )
+
+    mesh = make_mesh(stream=4, metric=2)
+    ps = np.array([0.0, 0.5, 0.99, 1.0], dtype=np.float32)
+    ingest, collect, make_partial = make_interval_distributed_step(
+        mesh, NUM_METRICS, CFG.bucket_limit, ps, batch_size=BATCH
+    )
+    rng = np.random.default_rng(13)
+    n_batches = 3
+    batches = []
+    for _ in range(n_batches):
+        ids = ((rng.zipf(1.3, BATCH) - 1) % NUM_METRICS).astype(np.int32)
+        values = rng.lognormal(10, 2, BATCH).astype(np.float32)
+        batches.append((ids, values))
+
+    partial = make_partial()
+    for ids, values in batches:
+        partial = ingest(partial, jnp.asarray(ids), jnp.asarray(values))
+    acc = make_sharded_accumulator(mesh, NUM_METRICS, CFG.num_buckets)
+    acc, partial, stats = collect(acc, partial)
+    counts = np.asarray(stats["counts"])
+    assert int(counts.sum()) == n_batches * BATCH
+
+    # parity vs the per-batch-psum design on the same sample stream
+    step = make_distributed_step(
+        mesh, NUM_METRICS, CFG.bucket_limit, ps, batch_size=BATCH
+    )
+    acc_pb = make_sharded_accumulator(mesh, NUM_METRICS, CFG.num_buckets)
+    for ids, values in batches:
+        acc_pb, stats_pb = step(
+            acc_pb, jnp.asarray(ids), jnp.asarray(values)
+        )
+    np.testing.assert_array_equal(np.asarray(acc), np.asarray(acc_pb))
+
+    # the returned fresh partial really is zeroed: a second interval
+    # carries nothing over
+    ids2 = ((rng.zipf(1.3, BATCH) - 1) % NUM_METRICS).astype(np.int32)
+    vals2 = rng.lognormal(10, 2, BATCH).astype(np.float32)
+    partial = ingest(partial, jnp.asarray(ids2), jnp.asarray(vals2))
+    acc, partial, stats = collect(acc, partial)
+    assert int(np.asarray(stats["counts"]).sum()) == (n_batches + 1) * BATCH
+
+
 def test_mesh_firehose_headline_shape_reports_rate():
     """BASELINE configs[4] signal in CI: the distributed firehose
     (on-device generation + psum merge) at the 10k-metric shape yields a
