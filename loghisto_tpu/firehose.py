@@ -155,6 +155,98 @@ def make_mesh_firehose_step(
     return wrapped
 
 
+def make_mesh_firehose_interval_step(
+    mesh,
+    num_metrics: int,
+    batch: int,
+    config: MetricConfig,
+    mean: float = 10.0,
+    sigma: float = 2.0,
+    ingest_path: str = "auto",
+):
+    """Interval-amortized distributed firehose (the firehose twin of
+    aggregator.make_interval_distributed_step): per-batch generation +
+    local fold with ZERO collectives, stream-axis psum once per collect.
+
+    Returns (ingest, collect, make_partial):
+      ingest(partial, key) -> (partial, key)   collective-free batch
+      collect(acc, partial) -> (acc, fresh_partial)  one psum/interval
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from loghisto_tpu.ops.dispatch import ingest_step_fn, resolve_ingest_path
+    from loghisto_tpu.ops.ingest import sanitize_ids
+    from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
+
+    n_stream = mesh.shape[STREAM_AXIS]
+    n_metric = mesh.shape[METRIC_AXIS]
+    if num_metrics % n_metric or batch % n_stream:
+        raise ValueError("metrics/batch must divide the mesh axes")
+    rows = num_metrics // n_metric
+    local_batch = batch // n_stream
+    ingest_path = resolve_ingest_path(
+        ingest_path, num_metrics, config.num_buckets,
+        mesh.devices.flat[0].platform, batch_size=local_batch, mesh=True,
+    )
+    generate = _make_sample_generator(num_metrics, mean, sigma)
+
+    def local_ingest(partial_local, key):
+        si = jax.lax.axis_index(STREAM_AXIS)
+        mi = jax.lax.axis_index(METRIC_AXIS)
+        ids, values = generate(jax.random.fold_in(key[0], si), local_batch)
+        local_ids = sanitize_ids(ids - mi * rows)
+        folded = ingest_step_fn(ingest_path)(
+            partial_local[0], local_ids, values,
+            config.bucket_limit, config.precision,
+        )
+        return folded[None]
+
+    ingest_inner = jax.shard_map(
+        local_ingest, mesh=mesh,
+        in_specs=(P(STREAM_AXIS, METRIC_AXIS, None), P()),
+        out_specs=P(STREAM_AXIS, METRIC_AXIS, None),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def ingest(partial, key):
+        key, sub = jax.random.split(key)
+        return ingest_inner(partial, sub[None]), key
+
+    def local_collect(acc_local, partial_local):
+        merged = jax.lax.psum(partial_local[0], STREAM_AXIS)
+        return acc_local + merged, jnp.zeros_like(partial_local)
+
+    collect = jax.jit(
+        jax.shard_map(
+            local_collect, mesh=mesh,
+            in_specs=(
+                P(METRIC_AXIS, None),
+                P(STREAM_AXIS, METRIC_AXIS, None),
+            ),
+            out_specs=(
+                P(METRIC_AXIS, None),
+                P(STREAM_AXIS, METRIC_AXIS, None),
+            ),
+        ),
+        donate_argnums=(0, 1),
+    )
+
+    def make_partial() -> jnp.ndarray:
+        sharding = NamedSharding(mesh, P(STREAM_AXIS, METRIC_AXIS, None))
+        return jax.device_put(
+            jnp.zeros(
+                (n_stream, num_metrics, config.num_buckets),
+                dtype=jnp.int32,
+            ),
+            sharding,
+        )
+
+    return ingest, collect, make_partial
+
+
 def run_firehose(
     num_metrics: int = 10_000,
     batch: int = 1 << 22,
@@ -175,8 +267,11 @@ def run_firehose(
     from loghisto_tpu.ops.stats import dense_stats
 
     config = config or MetricConfig()
+    ingest = collect = partial = None
     if mesh is not None:
-        step = make_mesh_firehose_step(
+        # interval-amortized SPMD: per-batch folds are collective-free;
+        # the stream-axis psum runs once per interval at collect
+        ingest, collect, make_partial = make_mesh_firehose_interval_step(
             mesh, num_metrics, batch, config, ingest_path=ingest_path
         )
     else:
@@ -200,12 +295,18 @@ def run_firehose(
         from loghisto_tpu.parallel.aggregator import make_sharded_accumulator
 
         acc = make_sharded_accumulator(mesh, num_metrics, config.num_buckets)
+        partial = make_partial()
+        key = jax.random.key(0)
+        partial, key = ingest(partial, key)  # compile both programs
+        acc, partial = collect(acc, partial)
+        jax.block_until_ready(acc)
+        acc = jnp.zeros_like(acc)  # discard warm-up samples
     else:
         acc = jnp.zeros((num_metrics, config.num_buckets), dtype=jnp.int32)
-    key = jax.random.key(0)
-    acc, key = step(acc, key)  # compile
-    jax.block_until_ready(acc)
-    acc = jnp.zeros_like(acc)  # discard warm-up samples from interval 1
+        key = jax.random.key(0)
+        acc, key = step(acc, key)  # compile
+        jax.block_until_ready(acc)
+        acc = jnp.zeros_like(acc)  # discard warm-up samples from interval 1
 
     total_samples = 0
     intervals = 0
@@ -215,7 +316,10 @@ def run_firehose(
         interval_samples = 0
         inflight = 0
         while time.perf_counter() - t_int < interval:
-            acc, key = step(acc, key)
+            if mesh is not None:
+                partial, key = ingest(partial, key)
+            else:
+                acc, key = step(acc, key)
             interval_samples += batch
             # bound the async dispatch queue: without this, a dispatcher
             # that runs ahead of the device (or of a slow link) enqueues
@@ -225,8 +329,10 @@ def run_firehose(
             # up with, not a backlog
             inflight += 1
             if inflight >= max_inflight:
-                jax.block_until_ready(acc)
+                jax.block_until_ready(partial if mesh is not None else acc)
                 inflight = 0
+        if mesh is not None:
+            acc, partial = collect(acc, partial)
         stats = stats_fn(acc, ps)
         counts = np.asarray(stats["counts"])
         pcts = np.asarray(stats["percentiles"])
