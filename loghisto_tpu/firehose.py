@@ -95,66 +95,6 @@ def make_firehose_step(
     return step
 
 
-def make_mesh_firehose_step(
-    mesh,
-    num_metrics: int,
-    batch: int,
-    config: MetricConfig,
-    mean: float = 10.0,
-    sigma: float = 2.0,
-    ingest_path: str = "auto",
-):
-    """Distributed firehose step over a ("stream","metric") mesh: each
-    device generates its own sample shard (keys split per stream index),
-    builds a local dense histogram via the dispatched accumulation kernel,
-    psum-merges across the stream axis, and folds into the metric-sharded
-    accumulator — the BASELINE configs[2] '8-way psum merge' exercised
-    end to end."""
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
-
-    from loghisto_tpu.ops.dispatch import resolve_ingest_path
-    from loghisto_tpu.parallel.aggregator import local_histogram_fold
-    from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
-
-    n_stream = mesh.shape[STREAM_AXIS]
-    n_metric = mesh.shape[METRIC_AXIS]
-    if num_metrics % n_metric or batch % n_stream:
-        raise ValueError("metrics/batch must divide the mesh axes")
-    rows = num_metrics // n_metric
-    local_batch = batch // n_stream
-    ingest_path = resolve_ingest_path(
-        ingest_path, num_metrics, config.num_buckets,
-        mesh.devices.flat[0].platform, batch_size=local_batch, mesh=True,
-    )
-    generate = _make_sample_generator(num_metrics, mean, sigma)
-
-    def local(acc_local, key):
-        si = jax.lax.axis_index(STREAM_AXIS)
-        ids, values = generate(jax.random.fold_in(key[0], si), local_batch)
-        return local_histogram_fold(
-            acc_local, ids, values, rows,
-            config.bucket_limit, config.precision,
-            ingest_path=ingest_path,
-        )
-
-    step = jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(P(METRIC_AXIS, None), P()),
-        out_specs=P(METRIC_AXIS, None),
-    )
-
-    @functools.partial(jax.jit, donate_argnums=0)
-    def wrapped(acc, key):
-        # proper split: the carry key must never collide with the
-        # per-device fold_in keys consumed inside the step
-        key, sub = jax.random.split(key)
-        return step(acc, sub[None]), key
-
-    return wrapped
-
-
 def make_mesh_firehose_interval_step(
     mesh,
     num_metrics: int,
@@ -165,8 +105,11 @@ def make_mesh_firehose_interval_step(
     ingest_path: str = "auto",
 ):
     """Interval-amortized distributed firehose (the firehose twin of
-    aggregator.make_interval_distributed_step): per-batch generation +
-    local fold with ZERO collectives, stream-axis psum once per collect.
+    aggregator.make_interval_distributed_step): each device generates its
+    own sample shard (keys split per stream index) and folds it into its
+    (stream, metric) partial block with ZERO collectives; the stream-axis
+    psum — the BASELINE configs[2] '8-way psum merge' — runs once per
+    collect, into the metric-sharded accumulator.
 
     Returns (ingest, collect, make_partial):
       ingest(partial, key) -> (partial, key)   collective-free batch

@@ -1100,7 +1100,9 @@ class TPUAggregator:
                 return
             # success-only reset, mirroring the raw flush loop
             self._device_down_until = 0.0
-            self._interval_ingested += int(weights[off:off + take].sum())
+            self._interval_ingested += int(
+                weights[off:off + take].sum(dtype=np.int64)
+            )
 
     def _on_device_failure_locked(self) -> None:
         """Device-failure bookkeeping (caller holds _dev_lock, and must

@@ -352,8 +352,13 @@ def test_preagg_boundary_values_conserve_counts():
         row = diff[r]
         nz = np.nonzero(row)[0]
         assert row.sum() == 0
-        assert np.all(np.abs(row[nz]) <= np.abs(row).max())
-        assert nz.max() - nz.min() <= 2 * len(nz)
+        # each disagreement moves exactly one count, one bucket over:
+        # +1/-1 pairs in adjacent buckets, nothing larger
+        assert np.all(np.abs(row[nz]) == 1), row[nz]
+        pos = nz[row[nz] > 0]
+        neg = nz[row[nz] < 0]
+        assert len(pos) == len(neg)
+        assert np.all(np.abs(np.sort(pos) - np.sort(neg)) == 1)
 
 
 def test_ship_packed_rejects_legacy_two_column_format():

@@ -80,22 +80,31 @@ def test_run_firehose_mesh_mode():
 
 def test_mesh_firehose_step_conserves_counts():
     # every generated sample lands exactly once despite the redundant
-    # per-metric-shard generation (same stream index -> same samples)
+    # per-metric-shard generation (same stream index -> same samples),
+    # across multiple collective-free batches and the one-psum collect
     import jax
     import numpy as np
 
-    from loghisto_tpu.firehose import make_mesh_firehose_step
+    from loghisto_tpu.firehose import make_mesh_firehose_interval_step
     from loghisto_tpu.parallel.mesh import make_mesh
     from loghisto_tpu.parallel import make_sharded_accumulator
 
     cfg = MetricConfig(bucket_limit=512)
     mesh = make_mesh(stream=4, metric=2)
-    step = make_mesh_firehose_step(mesh, 64, 8192, cfg)
-    acc = make_sharded_accumulator(mesh, 64, cfg.num_buckets)
+    ingest, collect, make_partial = make_mesh_firehose_interval_step(
+        mesh, 64, 8192, cfg
+    )
+    partial = make_partial()
     key = jax.random.key(7)
-    acc, key = step(acc, key)
-    acc, key = step(acc, key)
+    partial, key = ingest(partial, key)
+    partial, key = ingest(partial, key)
+    acc = make_sharded_accumulator(mesh, 64, cfg.num_buckets)
+    acc, partial = collect(acc, partial)
     assert int(np.asarray(acc).sum()) == 2 * 8192
+    # returned partial is zeroed: a second interval starts clean
+    partial, key = ingest(partial, key)
+    acc, partial = collect(acc, partial)
+    assert int(np.asarray(acc).sum()) == 3 * 8192
 
 
 def test_native_staging_aggregator_roundtrip():

@@ -83,17 +83,18 @@ def test_distributed_step_dispatched_kernels_match_scatter(path):
 
 
 def test_mesh_firehose_dispatched_path_matches_scatter():
-    from loghisto_tpu.firehose import make_mesh_firehose_step
+    from loghisto_tpu.firehose import make_mesh_firehose_interval_step
 
     mesh = make_mesh(stream=4, metric=2)
     cfg = MetricConfig(bucket_limit=128)
     accs = {}
     for path in ("scatter", "sort"):
-        step = make_mesh_firehose_step(
+        ingest, collect, make_partial = make_mesh_firehose_interval_step(
             mesh, 16, 1024, cfg, ingest_path=path
         )
+        partial, _ = ingest(make_partial(), jax.random.key(5))
         acc = make_sharded_accumulator(mesh, 16, cfg.num_buckets)
-        acc, _ = step(acc, jax.random.key(5))
+        acc, _ = collect(acc, partial)
         accs[path] = np.asarray(acc)
     np.testing.assert_array_equal(accs["scatter"], accs["sort"])
     assert accs["scatter"].sum() == 1024
