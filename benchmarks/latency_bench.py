@@ -65,10 +65,15 @@ def timer_loop(
     device: bool,
     interval: float = 1.0,
     fast_ingest: bool = True,
+    handle: bool = False,
 ) -> dict:
     """The reference readme's experiment: worker threads loop
     start_timer -> no-op -> stop; the system's own histogram of those
-    timings is the measurement-overhead distribution (ns)."""
+    timings is the measurement-overhead distribution (ns).
+
+    ``handle=True`` uses the reusable FastTimer handle
+    (``system.timer(name)``; one C call each side, locals-only plumbing)
+    instead of the per-measurement token — the product hot-loop API."""
     from loghisto_tpu.channel import Channel
     from loghisto_tpu.metrics import MetricSystem
 
@@ -91,12 +96,19 @@ def timer_loop(
     ops = [0] * concurrency
 
     def worker(i: int) -> None:
-        start_timer = ms.start_timer
         local = 0
-        while not stop.is_set():
-            token = start_timer(name)
-            token.stop()
-            local += 1
+        if handle:
+            t = ms.timer(name)
+            tstart, tstop = t.start, t.stop
+            while not stop.is_set():
+                tstop(tstart())
+                local += 1
+        else:
+            start_timer = ms.start_timer
+            while not stop.is_set():
+                token = start_timer(name)
+                token.stop()
+                local += 1
         ops[i] = local
 
     workers = [
@@ -129,6 +141,7 @@ def timer_loop(
         "concurrency": concurrency,
         "fast_ingest": fast_ingest,
         "device": device,
+        "api": "handle" if handle else "token",
         "ops_per_s": round(sum(ops) / elapsed, 1),
         "total_ops": sum(ops),
     }
@@ -151,6 +164,9 @@ def run(device: bool = False, seconds: float = 6.0, concurrency: int = 100,
         "direct_fastpath": direct_ns_per_op(True, direct_n),
         "direct_python": direct_ns_per_op(False, max(1, direct_n // 10)),
         "timer_loop": timer_loop(concurrency, seconds, device=False),
+        "timer_loop_handle": timer_loop(
+            concurrency, seconds, device=False, handle=True
+        ),
     }
     if device:
         result["timer_loop_device"] = timer_loop(

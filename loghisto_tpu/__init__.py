@@ -7,6 +7,8 @@ psum collectives.  See SURVEY.md for the structural map to the reference."""
 from loghisto_tpu.channel import Channel, ChannelClosed
 from loghisto_tpu.config import DEFAULT_PERCENTILES, MetricConfig
 from loghisto_tpu.metrics import (
+    FastTimer,
+    FastTimerToken,
     MetricSystem,
     ProcessedMetricSet,
     RawMetricSet,
@@ -26,6 +28,8 @@ __all__ = [
     "Channel",
     "ChannelClosed",
     "DEFAULT_PERCENTILES",
+    "FastTimer",
+    "FastTimerToken",
     "MetricConfig",
     "MetricSystem",
     "Metrics",
