@@ -193,7 +193,20 @@ def quantile(means, weights, qs):
     keep plain linear interpolation — geometric interpolation across a
     sparse body segment would bias toward the low endpoint (a two-sample
     {1, 1000} digest must report q50 ~ 500, not ~13), preserving the
-    small-N exactness contract."""
+    small-N exactness contract.
+
+    APPLICABILITY (the bimodal twin of the heavy-tail note above): a
+    body quantile that falls inside a DENSITY GAP — e.g. p50 of a
+    bimodal mix whose modes straddle the median — has no unique "right"
+    answer; this digest returns a value interpolated between the
+    gap-adjacent centroids (an observed-data-range answer), which can
+    sit ~46% from np.quantile's own interpolation (ACCURACY.md) while
+    both are inside the same empty gap.  If body quantiles of
+    multi-modal data must match rank-interpolation semantics, use the
+    log-bucket histogram (`loghisto_tpu.ops.stats`): it keeps exact
+    counts per bucket, so its answer lands in the correct mode every
+    time — the same division of labor as heavy tails, where t-digest
+    needs the power-law fit but loghist is exact by construction."""
     w_sorted_idx = jnp.argsort(jnp.where(weights > 0, means, jnp.inf))
     m = means[w_sorted_idx]
     w = weights[w_sorted_idx]
