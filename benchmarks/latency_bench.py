@@ -74,7 +74,9 @@ def timer_loop(
     ``handle=True`` uses the reusable FastTimer handle
     (``system.timer(name)``; one C call each side, locals-only plumbing)
     instead of the per-measurement token — the product hot-loop API."""
-    from loghisto_tpu.channel import Channel
+    import queue
+
+    from loghisto_tpu.channel import ChannelClosed, ResilientSubscription
     from loghisto_tpu.metrics import MetricSystem
 
     name = "benchmark_op"
@@ -89,8 +91,15 @@ def timer_loop(
         ms = MetricSystem(
             interval=interval, sys_stats=True, fast_ingest=fast_ingest
         )
-    mc = Channel(4)
-    ms.subscribe_to_processed_metrics(mc)
+    # ResilientSubscription: on this 1-core box 100 worker threads can
+    # starve the reader past strike-eviction; the resilient wrapper
+    # re-subscribes on a fresh channel (stalled intervals stay shed) so
+    # the reader keeps receiving boundary-aligned full intervals
+    mc = ResilientSubscription(
+        ms.subscribe_to_processed_metrics,
+        ms.unsubscribe_from_processed_metrics,
+        capacity=4,
+    )
     ms.start()
     stop = threading.Event()
     ops = [0] * concurrency
@@ -126,15 +135,29 @@ def timer_loop(
     while time.perf_counter() < deadline:
         try:
             pms = mc.get(timeout=0.5)
-        except Exception:
+        except queue.Empty:
             continue
+        except ChannelClosed:  # only after close(); defensive
+            break
         if pms.metrics.get(f"{name}_count", 0) > 0:
             last_full = pms
     stop.set()
     for w in workers:
         w.join(timeout=2.0)
     elapsed = time.perf_counter() - t0
+    # stop the reaper BEFORE any fallback collect: a racing tick would
+    # swap the partial buffers out from under it
     ms.stop()
+    if last_full is None:
+        # extreme starvation can still lose every boundary-aligned set;
+        # collect the final partial interval directly — same
+        # system-measured distribution, just not boundary-aligned
+        try:
+            pms = ms.process_metrics(ms.collect_raw_metrics())
+            if pms.metrics.get(f"{name}_count", 0) > 0:
+                last_full = pms
+        except Exception:
+            pass
     mc.close()
 
     out = {
