@@ -58,9 +58,11 @@ stop = threading.Event()
 
 
 def worker():
+    # hot-loop timing: the reusable C-clock handle (one extension call
+    # each side); start_timer tokens remain for reference-style callers
+    t = ms.timer("request_latency")
     while not stop.is_set():
-        with ms.start_timer("request_latency"):
-            pass
+        t.stop(t.start())
         ms.counter("requests", 1)
 
 
