@@ -86,6 +86,26 @@ def main() -> int:
     np.testing.assert_array_equal(counts, expected)
     total = int(counts.sum())
     assert total == global_batch
+
+    # interval-amortized design across the same real process boundary:
+    # two collective-free ingests, one psum at collect (VERDICT r3
+    # item 3's path must hold multihost, not just single-process)
+    from loghisto_tpu.parallel import make_interval_distributed_step
+
+    ingest, collect, make_partial = make_interval_distributed_step(
+        mesh, m, cfg.bucket_limit, np.array([0.5, 1.0], dtype=np.float32)
+    )
+    partial = ingest(make_partial(), gids, gvalues)
+    partial = ingest(partial, gids, gvalues)
+    acc2 = make_sharded_accumulator(mesh, m, cfg.num_buckets)
+    acc2, partial, stats2 = collect(acc2, partial)
+    counts2 = np.asarray(
+        jax.experimental.multihost_utils.process_allgather(
+            stats2["counts"], tiled=True
+        )
+    )
+    np.testing.assert_array_equal(counts2, 2 * expected)
+
     jax.distributed.shutdown()
     print(f"WORKER {pid} OK {total}", flush=True)
     return 0
