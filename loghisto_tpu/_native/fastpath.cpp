@@ -41,7 +41,7 @@ FastBuf* get_buf(PyObject* capsule) {
 
 // Single stage-or-shed policy shared by record() and timer_stop(): cap
 // check, int32 id cast, drop accounting — one place to change.
-inline void stage_sample(FastBuf* fb, long id, double v) {
+inline int64_t stage_sample(FastBuf* fb, long id, double v) {
   std::lock_guard<std::mutex> lock(fb->mu);
   if (static_cast<int64_t>(fb->ids.size()) < fb->cap) {
     fb->ids.push_back(static_cast<int32_t>(id));
@@ -49,6 +49,7 @@ inline void stage_sample(FastBuf* fb, long id, double v) {
   } else {
     ++fb->dropped;
   }
+  return static_cast<int64_t>(fb->ids.size());
 }
 
 void destroy_buf(PyObject* capsule) {
@@ -89,6 +90,24 @@ PyObject* fb_record(PyObject*, PyObject* const* args, Py_ssize_t nargs) {
   if (v == -1.0 && PyErr_Occurred()) return nullptr;
   stage_sample(fb, id, v);
   Py_RETURN_NONE;
+}
+
+// record_sized: like record(), but returns the post-stage buffer size so
+// a per-name bound recorder can do its fold check with one int compare
+// instead of the Python-side thread-local stride machinery.
+PyObject* fb_record_sized(PyObject*, PyObject* const* args,
+                          Py_ssize_t nargs) {
+  if (nargs != 3) {
+    PyErr_SetString(PyExc_TypeError, "record_sized(buf, metric_id, value)");
+    return nullptr;
+  }
+  FastBuf* fb = get_buf(args[0]);
+  if (!fb) return nullptr;
+  long id = PyLong_AsLong(args[1]);
+  if (id == -1 && PyErr_Occurred()) return nullptr;
+  double v = PyFloat_AsDouble(args[2]);
+  if (v == -1.0 && PyErr_Occurred()) return nullptr;
+  return PyLong_FromLongLong(stage_sample(fb, id, v));
 }
 
 PyObject* fb_drain(PyObject*, PyObject* const* args, Py_ssize_t nargs) {
@@ -149,9 +168,11 @@ PyObject* fb_timer_start(PyObject*, PyObject* const*, Py_ssize_t nargs) {
   return PyLong_FromLongLong(monotonic_ns());
 }
 
-// timer_stop(buf, metric_id, start_ns) -> duration_ns; stages
-// (metric_id, duration) into the FastBuf after the clock read, so the
-// staging cost lands outside the measured gap.
+// timer_stop(buf, metric_id, start_ns) -> (duration_ns, staged_size);
+// the clock is read FIRST (before arg parsing), staging happens after
+// the gap closes, and the post-stage size rides back in the same call
+// so the caller's fold check is one int compare — no separate size()
+// call, no stride bookkeeping.
 PyObject* fb_timer_stop(PyObject*, PyObject* const* args, Py_ssize_t nargs) {
   const int64_t now = monotonic_ns();
   if (nargs != 3) {
@@ -165,8 +186,20 @@ PyObject* fb_timer_stop(PyObject*, PyObject* const* args, Py_ssize_t nargs) {
   long long start = PyLong_AsLongLong(args[2]);
   if (start == -1 && PyErr_Occurred()) return nullptr;
   const int64_t dur = now - static_cast<int64_t>(start);
-  stage_sample(fb, id, static_cast<double>(dur));
-  return PyLong_FromLongLong(dur);
+  const int64_t size = stage_sample(fb, id, static_cast<double>(dur));
+  PyObject* out = PyTuple_New(2);
+  if (!out) return nullptr;
+  PyObject* d = PyLong_FromLongLong(dur);
+  PyObject* s = PyLong_FromLongLong(size);
+  if (!d || !s) {
+    Py_XDECREF(d);
+    Py_XDECREF(s);
+    Py_DECREF(out);
+    return nullptr;
+  }
+  PyTuple_SET_ITEM(out, 0, d);
+  PyTuple_SET_ITEM(out, 1, s);
+  return out;
 }
 
 PyObject* fb_size(PyObject*, PyObject* const* args, Py_ssize_t nargs) {
@@ -185,6 +218,9 @@ PyMethodDef kMethods[] = {
      "create(capacity) -> buffer capsule"},
     {"record", reinterpret_cast<PyCFunction>(fb_record), METH_FASTCALL,
      "record(buf, metric_id, value)"},
+    {"record_sized", reinterpret_cast<PyCFunction>(fb_record_sized),
+     METH_FASTCALL,
+     "record_sized(buf, metric_id, value) -> staged size after append"},
     {"drain", reinterpret_cast<PyCFunction>(fb_drain), METH_FASTCALL,
      "drain(buf) -> (ids_bytes, values_bytes, dropped)"},
     {"size", reinterpret_cast<PyCFunction>(fb_size), METH_FASTCALL,
@@ -193,7 +229,7 @@ PyMethodDef kMethods[] = {
      METH_FASTCALL, "timer_start() -> monotonic ns stamp"},
     {"timer_stop", reinterpret_cast<PyCFunction>(fb_timer_stop),
      METH_FASTCALL,
-     "timer_stop(buf, metric_id, start_ns) -> duration ns (staged)"},
+     "timer_stop(buf, metric_id, start_ns) -> (duration ns, staged size)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -181,11 +181,11 @@ class FastTimerToken:
     first operation when ``timer_stop`` enters — so the measured gap
     carries only the Python call plumbing between the two C calls, not
     name resolution (done here, before the clock starts), not histogram
-    staging (done in C, after the clock stops), and not the fold poll
-    (done Python-side after the duration is taken).  Same API surface as
-    TimerToken (reference metrics.go:62-67)."""
+    staging (done in C, after the clock stops), and not the fold check
+    (one int compare on the staged size the C call returns).  Same API
+    surface as TimerToken (reference metrics.go:62-67)."""
 
-    __slots__ = ("name", "start_ns", "_stop_p", "_system")
+    __slots__ = ("name", "start_ns", "_stop_p", "_threshold", "_system")
 
     def __init__(self, name: str, system: "MetricSystem", stop_p):
         self.name = name
@@ -193,11 +193,13 @@ class FastTimerToken:
         # per-name functools.partial(timer_stop, buf, fid) shared across
         # tokens: two slot loads inside the measured gap instead of four
         self._stop_p = stop_p
+        self._threshold = system._fast_fold_threshold
         self.start_ns = system._fastpath.timer_start()
 
     def stop(self) -> int:
-        duration_ns = self._stop_p(self.start_ns)
-        self._system._fast_tick(self._system._fast_buf)
+        duration_ns, size = self._stop_p(self.start_ns)
+        if size >= self._threshold:
+            self._system._fast_fold()
         return duration_ns
 
     def __enter__(self) -> "FastTimerToken":
@@ -221,21 +223,63 @@ class FastTimer:
         dur_ns = timer.stop(t)
     """
 
-    __slots__ = ("name", "_start_fn", "_stop_p", "_system")
+    __slots__ = ("name", "_start_fn", "_stop_p", "_threshold", "_system")
 
     def __init__(self, name: str, system: "MetricSystem", stop_p):
         self.name = name
         self._system = system
         self._start_fn = system._fastpath.timer_start
         self._stop_p = stop_p
+        self._threshold = system._fast_fold_threshold
 
     def start(self) -> int:
         return self._start_fn()
 
     def stop(self, start_ns: int) -> int:
-        duration_ns = self._stop_p(start_ns)
-        self._system._fast_tick(self._system._fast_buf)
+        duration_ns, size = self._stop_p(start_ns)
+        if size >= self._threshold:
+            self._system._fast_fold()
         return duration_ns
+
+
+class FastRecorder:
+    """Reusable per-name histogram recorder for hot loops: resolves the
+    metric name once, then ``record(value)`` is ONE C staging call
+    (``record_sized``, which returns the post-stage buffer size) plus an
+    int compare against the fold threshold — the per-call twin of
+    FastTimer, without even the thread-local stride poll the generic
+    ``histogram(name, value)`` path pays (the exact size comes back for
+    free from the C call, so the fold check is precise, not strided).
+
+        rec = system.recorder("payload_bytes")
+        rec.record(len(payload))
+    """
+
+    __slots__ = ("name", "_rec_p", "_threshold", "_system")
+
+    def __init__(self, name: str, system: "MetricSystem", rec_p):
+        self.name = name
+        self._system = system
+        self._rec_p = rec_p
+        self._threshold = system._fast_fold_threshold
+
+    def record(self, value: float) -> None:
+        if self._rec_p(value) >= self._threshold:
+            self._system._fast_fold()
+
+
+class _PyRecorder:
+    """Python fallback for systems without fast_ingest: same
+    record(value) surface, routed through histogram()."""
+
+    __slots__ = ("name", "_system")
+
+    def __init__(self, name: str, system: "MetricSystem"):
+        self.name = name
+        self._system = system
+
+    def record(self, value: float) -> None:
+        self._system.histogram(self.name, value)
 
 
 class _Shard:
@@ -375,12 +419,14 @@ class MetricSystem:
         self._fast_tick(buf)
 
     def _fast_tick(self, buf) -> None:
-        """Fold-threshold poll after a fast-path record (shared with the
-        C timer token, whose staging happens inside the extension).
-        The trigger uses a THREAD-LOCAL stride counter plus the
-        extension's authoritative ``size(buf)`` — a shared Python
-        counter would lose increments under concurrent writers and let
-        the staging buffer overflow before a fold fires."""
+        """Fold-threshold poll after a fast-path record (the
+        histogram()/counter() path; the timer and recorder handles get
+        the exact staged size back from their C call and compare it
+        directly instead).  The trigger uses a THREAD-LOCAL stride
+        counter plus the extension's authoritative ``size(buf)`` — a
+        shared Python counter would lose increments under concurrent
+        writers and let the staging buffer overflow before a fold
+        fires."""
         tl = self._thread_local
         n = getattr(tl, "fast_n", 0) + 1
         # stride scales down with the threshold so shrunken test buffers
@@ -544,6 +590,18 @@ class MetricSystem:
         if self._fast_record is not None:
             return FastTimer(name, self, self._fast_stop_partial(name))
         return _PyTimer(name, self)
+
+    def recorder(self, name: str) -> "FastRecorder | _PyRecorder":
+        """Reusable per-name histogram recorder for hot loops (name
+        resolved once; record(value) is one C call + fold poll); see
+        FastRecorder.  Python fallback without fast_ingest."""
+        if self._fast_record is not None:
+            rec_p = functools.partial(
+                self._fastpath.record_sized, self._fast_buf,
+                self._fast_id(name),
+            )
+            return FastRecorder(name, self, rec_p)
+        return _PyRecorder(name, self)
 
     def _fast_stop_partial(self, name: str):
         """Per-name functools.partial(timer_stop, buf, fid), cached —

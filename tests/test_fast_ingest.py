@@ -147,6 +147,35 @@ def test_timer_handle_python_fallback():
     assert out["fb_count"] == 1
 
 
+def test_fast_recorder_exact_and_folds():
+    """recorder(name): per-name bound staging must be sample-exact,
+    survive a small hammered buffer (fold poll engaged), and match
+    histogram()'s distribution for the same values."""
+    ms = MetricSystem(interval=3600, sys_stats=False, fast_ingest=True)
+    ms._fast_fold_threshold = 1000
+    ms._fast_buf = ms._fastpath.create(2000)
+    rec = ms.recorder("r")
+    n = 30_000
+    for i in range(n):
+        rec.record(float(i % 50 + 1))
+    for i in range(n):
+        ms.histogram("h", float(i % 50 + 1))
+    out = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert out["r_count"] == n
+    assert out["h_count"] == n
+    assert ms._fast_dropped_total == 0
+    for p in ("_50", "_99", "_min", "_max", "_sum"):
+        assert out["r" + p] == out["h" + p], p
+
+
+def test_recorder_python_fallback():
+    ms = MetricSystem(interval=3600, sys_stats=False)
+    rec = ms.recorder("fb")
+    rec.record(42.0)
+    out = ms.process_metrics(ms.collect_raw_metrics()).metrics
+    assert out["fb_count"] == 1
+
+
 def test_fast_timer_folds_before_buffer_fills():
     """Timer staging bypasses _fast_put, so it must still trigger the
     fold poll — a small buffer hammered by timer samples loses nothing."""
