@@ -7,6 +7,7 @@ psum collectives.  See SURVEY.md for the structural map to the reference."""
 from loghisto_tpu.channel import Channel, ChannelClosed
 from loghisto_tpu.config import DEFAULT_PERCENTILES, MetricConfig
 from loghisto_tpu.metrics import (
+    FastCounter,
     FastRecorder,
     FastTimer,
     FastTimerToken,
@@ -29,6 +30,7 @@ __all__ = [
     "Channel",
     "ChannelClosed",
     "DEFAULT_PERCENTILES",
+    "FastCounter",
     "FastRecorder",
     "FastTimer",
     "FastTimerToken",

@@ -282,6 +282,54 @@ class _PyRecorder:
         self._system.histogram(self.name, value)
 
 
+class FastCounter:
+    """Reusable per-name counter handle — the counter twin of
+    FastRecorder (counters are the reference's other per-call hot path,
+    metrics.go:251-269).  ``add(amount)`` is one C staging call + an int
+    compare; amounts outside the integer-exact window (non-int, or
+    |amount| > 2^31) take the full counter() path, preserving its
+    exactness contract.
+
+        reqs = system.counter_handle("requests")
+        reqs.add(1)
+    """
+
+    __slots__ = ("name", "_add_p", "_threshold", "_system")
+
+    def __init__(self, name: str, system: "MetricSystem", add_p):
+        self.name = name
+        self._system = system
+        self._add_p = add_p
+        self._threshold = system._fast_fold_threshold
+
+    def add(self, amount: int = 1) -> None:
+        if type(amount) is int and _I32_LO <= amount <= _I32_HI:
+            if self._add_p(amount) >= self._threshold:
+                self._system._fast_fold()
+        else:
+            self._system.counter(self.name, amount)
+
+
+# The integer-exactness window both counter paths share (one spelling:
+# the 2^53 float64 fold bound in counter()'s docstring is derived from
+# it, so the two APIs must never drift apart).
+_I32_LO = -(1 << 31)
+_I32_HI = 1 << 31
+
+
+class _PyCounter:
+    """Python fallback counter handle: same add(amount) surface."""
+
+    __slots__ = ("name", "_system")
+
+    def __init__(self, name: str, system: "MetricSystem"):
+        self.name = name
+        self._system = system
+
+    def add(self, amount: int = 1) -> None:
+        self._system.counter(self.name, amount)
+
+
 class _Shard:
     """One lock stripe of the ingest path: counter dict + histogram
     append-buffers + folded sparse bucket counts.  Threads are assigned a
@@ -447,21 +495,24 @@ class MetricSystem:
         if (
             self._fast_record is not None
             and type(amount) is int
-            and -(1 << 31) <= amount <= 1 << 31
+            and _I32_LO <= amount <= _I32_HI
         ):
-            buf = self._fast_counter_buf
-            if buf is None:
-                with self._fast_lock:
-                    if self._fast_counter_buf is None:
-                        self._fast_counter_buf = self._fastpath.create(
-                            1 << 22
-                        )
-                    buf = self._fast_counter_buf
-            self._fast_put(buf, name, amount)
+            self._fast_put(self._fast_ensure_counter_buf(), name, amount)
             return
         shard = self._shard()
         with shard.lock:
             shard.counters[name] = shard.counters.get(name, 0) + amount
+
+    def _fast_ensure_counter_buf(self):
+        """Lazily create the counter staging buffer (double-checked; the
+        one creation policy counter() and counter_handle() share)."""
+        buf = self._fast_counter_buf
+        if buf is None:
+            with self._fast_lock:
+                if self._fast_counter_buf is None:
+                    self._fast_counter_buf = self._fastpath.create(1 << 22)
+                buf = self._fast_counter_buf
+        return buf
 
     def _fast_id(self, name: str) -> int:
         with self._fast_lock:
@@ -602,6 +653,17 @@ class MetricSystem:
             )
             return FastRecorder(name, self, rec_p)
         return _PyRecorder(name, self)
+
+    def counter_handle(self, name: str) -> "FastCounter | _PyCounter":
+        """Reusable per-name counter handle for hot loops; see
+        FastCounter.  Python fallback without fast_ingest."""
+        if self._fast_record is not None:
+            add_p = functools.partial(
+                self._fastpath.record_sized,
+                self._fast_ensure_counter_buf(), self._fast_id(name),
+            )
+            return FastCounter(name, self, add_p)
+        return _PyCounter(name, self)
 
     def _fast_stop_partial(self, name: str):
         """Per-name functools.partial(timer_stop, buf, fid), cached —

@@ -168,6 +168,34 @@ def test_fast_recorder_exact_and_folds():
         assert out["r" + p] == out["h" + p], p
 
 
+def test_counter_handle_exact_incl_fallback_amounts():
+    """counter_handle: int increments stage in C; huge or non-int
+    amounts route through counter()'s exactness-preserving path; the
+    lifetime total is exact either way."""
+    ms = MetricSystem(interval=3600, sys_stats=False, fast_ingest=True)
+    cnt = ms.counter_handle("reqs")
+    n = 20_000
+    for _ in range(n):
+        cnt.add(1)
+    cnt.add(5)
+    cnt.add(1 << 40)   # outside int32-exact window -> slow path
+    cnt.add(2.5)       # non-int -> slow path
+    raw = ms.collect_raw_metrics()
+    assert raw.counters["reqs"] == n + 5 + (1 << 40) + 2.5
+
+
+def test_counter_handle_folds_small_buffer():
+    ms = MetricSystem(interval=3600, sys_stats=False, fast_ingest=True)
+    ms._fast_fold_threshold = 500
+    cnt = ms.counter_handle("c")
+    n = 20_000
+    for _ in range(n):
+        cnt.add(1)
+    raw = ms.collect_raw_metrics()
+    assert raw.counters["c"] == n
+    assert ms._fast_counter_dropped_total == 0
+
+
 def test_recorder_python_fallback():
     ms = MetricSystem(interval=3600, sys_stats=False)
     rec = ms.recorder("fb")
