@@ -38,8 +38,9 @@ import sys as _sys
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
 
-def direct_ns_per_op(fast: bool, n: int) -> dict:
-    """Tight-loop per-call cost of histogram() on an idle (never-started)
+def direct_ns_per_op(fast: bool, n: int, handle: bool = False) -> dict:
+    """Tight-loop per-call cost of histogram() — or, with handle=True,
+    the per-name recorder handle — on an idle (never-started)
     MetricSystem.  A long interval keeps the reaper out of the loop; the
     fastpath's half-capacity folds still fire, so the figure includes the
     amortized fold cost a real caller pays."""
@@ -48,6 +49,15 @@ def direct_ns_per_op(fast: bool, n: int) -> dict:
     ms = MetricSystem(interval=3600.0, sys_stats=False, fast_ingest=fast)
     if fast and ms._fast_record is None:
         return {"available": False}
+    if handle:
+        rec = ms.recorder("latency_op").record
+        for _ in range(10_000):  # warm: first-touch allocations, one fold
+            rec(123.456)
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            rec(123.456)
+        dt = time.perf_counter_ns() - t0
+        return {"available": True, "ns_per_op": round(dt / n, 1), "n": n}
     hist = ms.histogram
     # warm: name registration, first-touch allocations, one fold
     for _ in range(10_000):
@@ -185,6 +195,9 @@ def run(device: bool = False, seconds: float = 6.0, concurrency: int = 100,
     result = {
         "go_reference_p50_ns": 58.74,  # /root/reference/readme.md:42
         "direct_fastpath": direct_ns_per_op(True, direct_n),
+        "direct_recorder_handle": direct_ns_per_op(
+            True, direct_n, handle=True
+        ),
         "direct_python": direct_ns_per_op(False, max(1, direct_n // 10)),
         "timer_loop": timer_loop(concurrency, seconds, device=False),
         "timer_loop_handle": timer_loop(
