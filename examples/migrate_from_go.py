@@ -60,3 +60,13 @@ ms.stop()
 #                        mesh=make_mesh())   # psum merges across chips
 #   ...same calls...
 #   print(ms.device_metrics().metrics["some_ipc_latency_99.99"])
+#
+# And for per-call hot loops, resolve the name once (Go's map lookup per
+# call becomes one C staging call per event; with fast_ingest=True):
+#
+#   lat = ms.timer("some_ipc_latency")      # 2 C clock reads/measurement
+#   splits = ms.counter_handle("range_splits")
+#   bytes_in = ms.recorder("payload_bytes")
+#   t = lat.start(); ...; lat.stop(t)
+#   splits.add(1)
+#   bytes_in.record(4096.0)
