@@ -6,6 +6,17 @@ set -u
 cd "$(dirname "$0")/.."
 TAG="${1:-r2}"
 MAX_HOURS="${2:-11}"
+# SINGLE INSTANCE: rounds 3-5 each left their 11h watcher running into
+# the next round, so up to four watchers' PJRT init attempts stomped the
+# one tunnel concurrently — every attempt wedged (round 2's lone watcher
+# captured fine).  Kill any other watcher/capture before starting.
+for pid in $(pgrep -f "tpu_watch.sh" 2>/dev/null); do
+  [ "$pid" != "$$" ] && kill -9 "$pid" 2>/dev/null
+done
+for pid in $(pgrep -f "tpu_oneshot.py" 2>/dev/null); do
+  kill -9 -- "-$pid" 2>/dev/null
+  kill -9 "$pid" 2>/dev/null
+done
 DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
 ATTEMPT=0
 # A wedged tunnel hangs PJRT init ~25 min before failing; a HEALTHY init
