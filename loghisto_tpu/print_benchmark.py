@@ -55,6 +55,7 @@ def print_benchmark(
     out: TextIO = sys.stdout,
     fast_ingest: bool = True,
     device: bool = False,
+    handles: bool = False,
 ) -> None:
     """Run `op` at `concurrency` and print statistics each interval.
 
@@ -63,6 +64,10 @@ def print_benchmark(
     fast_ingest=False to benchmark the pure-Python hot path).
     `device=True` runs the same harness on a TPUMetricSystem, printing
     statistics computed by the device aggregation path.
+    `handles=True` times each op with the reusable per-name timer handle
+    (`system.timer(name)`) instead of per-measurement tokens — the
+    product hot-loop path; tokens remain the default because the
+    reference's harness is token-shaped (print_benchmark.go:61-66).
     """
     if device:
         from loghisto_tpu.system import TPUMetricSystem
@@ -113,10 +118,18 @@ def print_benchmark(
     recv_thread.start()
 
     def worker():
-        while not stop.is_set():
-            token = ms.start_timer(name)
-            op()
-            token.stop()
+        if handles:
+            t = ms.timer(name)
+            tstart, tstop = t.start, t.stop
+            while not stop.is_set():
+                s = tstart()
+                op()
+                tstop(s)
+        else:
+            while not stop.is_set():
+                token = ms.start_timer(name)
+                op()
+                token.stop()
 
     workers = [
         threading.Thread(target=worker, daemon=True)
@@ -159,6 +172,11 @@ def main(argv: Optional[list[str]] = None) -> None:
         "--device", action="store_true",
         help="aggregate on the device (TPUMetricSystem)",
     )
+    parser.add_argument(
+        "--handles", action="store_true",
+        help="time with the reusable per-name handle (product hot loop) "
+             "instead of per-measurement tokens",
+    )
     args = parser.parse_args(argv)
 
     def op() -> None:
@@ -168,6 +186,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         args.name, args.concurrency, op,
         duration=args.seconds, interval=args.interval,
         fast_ingest=not args.no_fast, device=args.device,
+        handles=args.handles,
     )
 
 

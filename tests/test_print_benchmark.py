@@ -49,3 +49,23 @@ def test_print_benchmark_cli_smoke():
     from loghisto_tpu.print_benchmark import main
 
     main(["--concurrency", "2", "--seconds", "0.3", "--interval", "0.1"])
+
+
+def test_print_benchmark_handles_mode_reports_samples():
+    import io
+
+    from loghisto_tpu.print_benchmark import print_benchmark
+
+    out = io.StringIO()
+    print_benchmark(
+        "h_op", concurrency=2, op=lambda: None,
+        duration=0.7, interval=0.2, out=out, handles=True,
+    )
+    report = out.getvalue()
+    assert "h_op_count:" in report
+    for line in report.splitlines():
+        if line.startswith("h_op_count:"):
+            if float(line.split("\t")[-1]) > 0:
+                break
+    else:
+        raise AssertionError("handles mode reported no samples:\n" + report)
