@@ -201,9 +201,12 @@ def run_firehose(
     out=sys.stdout,
     max_inflight: int = 8,
     ingest_path: str = "auto",
+    max_interval_samples: Optional[int] = None,
 ) -> dict:
     """Run the firehose; returns a summary dict (samples/s, intervals).
-    With `mesh`, generation+aggregation run SPMD with psum merges."""
+    With `mesh`, generation+aggregation run SPMD with psum merges.
+    `max_interval_samples` overrides the int32-exactness early-close
+    budget (default 2^31 - batch; see the guard below)."""
     import jax
     import jax.numpy as jnp
 
@@ -251,6 +254,16 @@ def run_firehose(
         jax.block_until_ready(acc)
         acc = jnp.zeros_like(acc)  # discard warm-up samples from interval 1
 
+    # int32-exactness budget: the dense accumulator (and mesh partials)
+    # are int32, and the worst case concentrates every sample of an
+    # interval in one cell.  At TPU-scale rates (1e9/s) a >2s interval
+    # would cross 2^31 — stop dispatching and close the interval early
+    # instead of silently wrapping (TPUAggregator spills to host int64
+    # for the same reason; the firehose's synthetic load just closes the
+    # interval, which is exact).
+    if max_interval_samples is None:
+        max_interval_samples = (1 << 31) - batch
+
     total_samples = 0
     intervals = 0
     t_start = time.perf_counter()
@@ -259,6 +272,12 @@ def run_firehose(
         interval_samples = 0
         inflight = 0
         while time.perf_counter() - t_int < interval:
+            if interval_samples >= max_interval_samples:
+                out.write(
+                    "interval closing early: int32 accumulator budget "
+                    f"({interval_samples:,} samples)\n"
+                )
+                break
             if mesh is not None:
                 partial, key = ingest(partial, key)
             else:

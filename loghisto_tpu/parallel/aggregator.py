@@ -202,6 +202,14 @@ def make_interval_distributed_step(
           One psum over the stream axis, fold into the metric-sharded
           accumulator, stats on the merged rows; returns a zeroed
           partial so the caller just rebinds both carries.
+
+    Overflow contract (same int32 budget as the per-batch design): the
+    partials and the accumulator are int32, and the worst case
+    concentrates every sample in one cell — callers must collect before
+    an interval ingests 2^31 samples globally (at the 1e9/s north-star
+    rate that is a 2-second interval).  TPUAggregator enforces this with
+    its host int64 spill; raw step-factory callers own the bound, like
+    run_firehose's early-close guard.
     """
     n_metric = mesh.shape[METRIC_AXIS]
     n_stream = mesh.shape[STREAM_AXIS]

@@ -107,6 +107,31 @@ def test_mesh_firehose_step_conserves_counts():
     assert int(np.asarray(acc).sum()) == 3 * 8192
 
 
+def test_firehose_int32_budget_closes_interval_early():
+    """The int32-exactness guard: once an interval's dispatched samples
+    reach the budget, the interval closes early (exact) instead of
+    letting a hot cell wrap.  Budget shrunk so CI exercises the path."""
+    import io
+
+    out = io.StringIO()
+    summary = run_firehose(
+        num_metrics=16, batch=4096, seconds=1.2, interval=0.6,
+        config=MetricConfig(bucket_limit=128), out=out,
+        max_interval_samples=8192,
+    )
+    assert "int32 accumulator budget" in out.getvalue()
+    # every reported interval stopped at (or under) the budget + 1 batch
+    import re
+
+    reports = re.findall(
+        r"^interval \d+: ([\d,]+) samples", out.getvalue(), re.M
+    )
+    assert reports
+    for count in reports:
+        assert int(count.replace(",", "")) <= 8192 + 4096
+    assert summary["intervals"] >= 1
+
+
 def test_native_staging_aggregator_roundtrip():
     from loghisto_tpu import _native
     from loghisto_tpu.parallel.aggregator import TPUAggregator
