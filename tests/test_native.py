@@ -1,6 +1,7 @@
 """Native C++ ingest runtime tests: codec parity with the NumPy tier,
 staging buffer semantics, dense-accumulate verification twin."""
 
+import os
 import threading
 
 import numpy as np
@@ -239,6 +240,34 @@ def test_sharded_cell_store_concurrent_exactness():
     drained.append(store.drain_packed_all())
     total = sum(int(p[:, 2].sum(dtype=np.int64)) for p in drained if len(p))
     assert total == 4 * per_thread * batch
+    store.close()
+
+
+@pytest.mark.skipif(
+    not os.environ.get("LOGHISTO_SLOW_TESTS"),
+    reason="~20s of hot-cell adds; run with LOGHISTO_SLOW_TESTS=1 "
+           "(validated manually in round 5 — see commit message)",
+)
+def test_drain_packed_splits_counts_above_int32_cap():
+    """A cell folded past the 2^30-1 drain cap must come back as
+    MULTIPLE int32 rows across drain passes, conserving the exact int64
+    total (the C side leaves the remainder in the table; the Python
+    drain loops until empty)."""
+    store = _native.CellStore(bucket_limit=64)
+    ids = np.zeros(1 << 22, dtype=np.int32)
+    vals = np.full(1 << 22, 10.0, dtype=np.float32)
+    reps = (1 << 8) + 1  # 2^30 + 2^22 samples, one cell
+    for _ in range(reps):
+        assert store.add(ids, vals) == len(ids)
+    total = reps << 22
+    packed = store.drain_packed()
+    assert len(store) == 0
+    assert packed.dtype == np.int32 and packed.shape[1] == 3
+    assert len(packed) == 2  # cap row + remainder row
+    assert (packed[:, 0] == 0).all()
+    counts = packed[:, 2].astype(np.int64)
+    assert counts.max() == (1 << 30) - 1
+    assert int(counts.sum()) == total
     store.close()
 
 
