@@ -24,7 +24,27 @@ ATTEMPT=0
 # after INIT_TIMEOUT so the retry cadence tracks short healthy windows
 # (one init per process either way — the probe IS the capture).
 INIT_TIMEOUT=360
+# Relay-port probe (round 5 diagnosis): jax.devices() goes through the
+# axon loopback relay on 127.0.0.1:8083 (axon/register/pjrt.py:188);
+# when NOTHING is listening there (netstat showed no listener for the
+# whole of rounds 3-5), a PJRT attempt can only burn its 6-minute init
+# window.  Poll the port every 20s and attempt the moment it opens —
+# reaction time drops from one 11-minute blind cycle to ~20s.  A blind
+# attempt still fires every BLIND_EVERY seconds in case the probe
+# assumption is ever wrong.
+relay_up() {
+  (exec 3<>/dev/tcp/127.0.0.1/8083) 2>/dev/null && { exec 3>&-; return 0; }
+  return 1
+}
+BLIND_EVERY=3600
+LAST_ATTEMPT=0
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  NOW=$(date +%s)
+  if ! relay_up && [ $((NOW - LAST_ATTEMPT)) -lt "$BLIND_EVERY" ]; then
+    sleep 20
+    continue
+  fi
+  LAST_ATTEMPT=$NOW
   ATTEMPT=$((ATTEMPT + 1))
   OUT="tpu_results_${TAG}_a${ATTEMPT}"
   LOG="${OUT}.log"
@@ -62,9 +82,9 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     echo "=== attempt $ATTEMPT partial: kept stage results in TPU_CAPTURE_partial ==="
   fi
   # rc=2: init reached a non-TPU platform; rc=124: timeout/wedge
-  echo "=== attempt $ATTEMPT failed rc=$rc; sleeping 300s ==="
+  echo "=== attempt $ATTEMPT failed rc=$rc; back to relay probe ==="
   rm -rf "$OUT" "$LOG" 2>/dev/null
-  sleep 300
+  sleep 30
 done
 echo "=== gave up after $ATTEMPT attempts ==="
 exit 1
