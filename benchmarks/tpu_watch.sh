@@ -11,7 +11,9 @@ MAX_HOURS="${2:-11}"
 # one tunnel concurrently — every attempt wedged (round 2's lone watcher
 # captured fine).  Kill any other watcher/capture before starting.
 for pid in $(pgrep -f "tpu_watch.sh" 2>/dev/null); do
-  [ "$pid" != "$$" ] && kill -9 "$pid" 2>/dev/null
+  # spare self AND the launching shell (whose cmdline quotes this
+  # script's name when started via bash -c '... tpu_watch.sh ...')
+  [ "$pid" != "$$" ] && [ "$pid" != "$PPID" ] && kill -9 "$pid" 2>/dev/null
 done
 for pid in $(pgrep -f "tpu_oneshot.py" 2>/dev/null); do
   kill -9 -- "-$pid" 2>/dev/null
