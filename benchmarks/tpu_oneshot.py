@@ -222,6 +222,28 @@ def main() -> int:
 
     stage(outdir, "device_paths")(paths)
 
+    # ---- stage 7: derive + write the dispatch threshold table from this
+    # capture's device_paths ranking (VERDICT r3 item 2's second half).
+    # Written straight into the package (ops/dispatch_thresholds.json);
+    # the round's end-of-round commit then lands it even if nobody is
+    # watching when the tunnel window opens. ----
+    def thresholds():
+        import subprocess
+
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "benchmarks",
+                                          "analyze_capture.py"),
+             "--emit-thresholds", outdir],
+            capture_output=True, text=True, timeout=120,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"emit-thresholds failed: {proc.stderr.strip()[-400:]}"
+            )
+        return {"stdout": proc.stdout.strip().splitlines()[-8:]}
+
+    stage(outdir, "thresholds")(thresholds)
+
     with open(os.path.join(outdir, "SUCCESS"), "w") as f:
         f.write(time.strftime("%Y-%m-%dT%H:%M:%S\n"))
     log(f"capture complete; results in {outdir}")
