@@ -160,6 +160,22 @@ def zipf_ids(rng: np.random.Generator, n: int, m: int) -> np.ndarray:
     return ((raw - 1) % m).astype(np.int32)
 
 
+def _cpu_calibration() -> float:
+    """Fixed-workload host-speed index (MB/s of a NumPy reduction over a
+    256 MB buffer).  This shared host's effective CPU speed swings >2x
+    between rounds (round-5 measured the same bench at 36-80 M samples/s
+    hours apart with identical code); CPU-fallback numbers are only
+    comparable ACROSS rounds at similar calibration values."""
+    buf = np.ones(1 << 25, dtype=np.float64)  # 256 MB
+    t0 = time.perf_counter()
+    s = 0.0
+    for _ in range(4):
+        s += float(buf.sum())
+    dt = time.perf_counter() - t0
+    assert s > 0
+    return round(4 * buf.nbytes / dt / 1e6, 1)
+
+
 def _start_watchdog(timeout_s: float = 420.0, on_timeout=None):
     """Fail loudly if device work wedges (the axon tunnel can hang
     indefinitely): after timeout_s without the ready flag, dump stacks to
@@ -296,6 +312,9 @@ def main() -> None:
         "samples_per_interval": head["samples"],
         "num_metrics": NUM_METRICS,
         "num_buckets": cfg.num_buckets,
+        # host-speed index for cross-round comparability of CPU numbers
+        # (this shared host swings >2x; see _cpu_calibration)
+        "cpu_calibration_mb_s": _cpu_calibration(),
     }
 
     # host-fed sustained rate through the full record_batch -> device
