@@ -642,7 +642,7 @@ class TPUAggregator:
         if self._cell_store is not None:
             from loghisto_tpu.ops.ingest import make_packed_ingest_fn
 
-            # preagg wire format: one int64 [n, 2] array per merge chunk
+            # preagg wire format: one int32 [n, 3] array per merge chunk
             self._packed_ingest = make_packed_ingest_fn(config.bucket_limit)
         self._stats_fn = jax.jit(
             functools.partial(
