@@ -290,3 +290,30 @@ def test_stop_then_start_resumes_collection():
     raw = ms.collect_raw_metrics()
     pm = ms.process_metrics(raw).metrics
     assert pm.get("h_agg_count", 0) >= 0  # processing stays functional
+
+
+def test_readme_quickstart_runs_verbatim():
+    """The README quick-start block, executed: counter + histogram +
+    timer context manager, channel iteration, percentile/rate keys
+    present.  Pins the first thing a migrating user will type."""
+    from loghisto_tpu import Channel, MetricSystem as MS
+
+    ms = MS(interval=0.15, sys_stats=True)
+    ms.start()
+    ms.counter("range_splits", 1)
+    ms.histogram("ipc_latency", 123.0)
+    with ms.start_timer("query"):
+        pass
+    ch = Channel(capacity=8)
+    ms.subscribe_to_processed_metrics(ch)
+    got = None
+    for pms in ch:  # iteration protocol, like the README shows
+        if pms.metrics.get("query_count", 0) >= 1:
+            got = pms
+            break
+    ms.stop()
+    assert got is not None
+    assert "query_99.9" in got.metrics
+    assert "range_splits_rate" in got.metrics
+    assert "sys.NumGoroutine" in got.metrics  # sys gauges on
+    ch.close()
