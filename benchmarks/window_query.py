@@ -1,0 +1,146 @@
+"""Window-query latency vs window length (ISSUE 1 acceptance): shows
+the timewheel query is ONE device reduction over the ring, not a
+per-interval host loop — latency must scale sublinearly (effectively
+flat) in the window length, because every query merges the same
+fixed-shape ring under a different slot mask.
+
+A host-side per-interval loop over the same data is measured alongside
+as the contrast: its cost grows linearly with the window, the wheel's
+does not.
+
+Usage: python benchmarks/window_query.py [--metrics 1024]
+       [--bucket-limit 4096] [--slots 64] [--reps 5] [--out FILE]
+Prints one JSON object (save as WINDOW_QUERY_r*.json); importable as
+``run(...)`` for tests/capture.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime as _dt
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import numpy as np
+
+
+def run(num_metrics: int = 1024, bucket_limit: int = 4_096,
+        slots: int = 64, samples_per_interval: int = 10_000,
+        reps: int = 5) -> dict:
+    import jax
+
+    from loghisto_tpu.config import MetricConfig
+    from loghisto_tpu.metrics import RawMetricSet
+    from loghisto_tpu.ops.codec import compress_np
+    from loghisto_tpu.window import TierSpec, TimeWheel
+
+    cfg = MetricConfig(bucket_limit=bucket_limit)
+    platform = jax.devices()[0].platform
+    wheel = TimeWheel(
+        num_metrics=num_metrics, config=cfg, interval=1.0,
+        tiers=[TierSpec(slots, 1)],
+    )
+
+    # fill the ring: every interval scatters a fresh lognormal batch over
+    # a handful of metric names (the sparse raw path, like live traffic)
+    rng = np.random.default_rng(0)
+    names = [f"m{i}" for i in range(8)]
+    t0 = _dt.datetime(2026, 1, 1, tzinfo=_dt.timezone.utc)
+    sparse_history = []  # per-interval {name: {bucket: count}} for the loop
+    for i in range(slots):
+        hists = {}
+        for name in names:
+            vals = rng.lognormal(8.0, 2.0, samples_per_interval // len(names))
+            buckets = compress_np(vals, cfg.precision)
+            ub, cnt = np.unique(buckets, return_counts=True)
+            hists[name] = {int(b): int(c) for b, c in zip(ub, cnt)}
+        sparse_history.append(hists)
+        wheel.push(RawMetricSet(
+            time=t0 + _dt.timedelta(seconds=i), counters={}, rates={},
+            histograms=hists, gauges={}, duration=1.0,
+        ))
+
+    ps = (0.5, 0.99)
+    windows = [w for w in (1, 2, 4, 8, 16, 32, slots) if w <= slots]
+    result = {
+        "metric": "window query latency vs window length",
+        "platform": platform,
+        "merge_path": wheel.merge_path,
+        "num_metrics": num_metrics,
+        "num_buckets": cfg.num_buckets,
+        "slots": slots,
+        "reps": reps,
+        "queries": {},
+    }
+    for w in windows:
+        wheel.query("*", float(w), ps)  # compile + warm this mask shape
+        times = []
+        for _ in range(reps):
+            t1 = time.perf_counter()
+            res = wheel.query("*", float(w), ps)
+            times.append(time.perf_counter() - t1)
+        assert res.slots == w
+
+        # contrast: per-interval host loop (sparse merge + numpy stats)
+        t1 = time.perf_counter()
+        merged: dict = {}
+        for hists in sparse_history[-w:]:
+            for name, buckets in hists.items():
+                dst = merged.setdefault(name, {})
+                for b, c in buckets.items():
+                    dst[b] = dst.get(b, 0) + c
+        t_loop = time.perf_counter() - t1
+
+        result["queries"][str(w)] = {
+            "device_median_ms": round(float(np.median(times)) * 1e3, 3),
+            "host_loop_merge_ms": round(t_loop * 1e3, 3),
+        }
+
+    qs = result["queries"]
+    w_lo, w_hi = str(windows[0]), str(windows[-1])
+    # headline ratio: device latency growth across a slots-times-wider
+    # window; ~1.0 means flat (sublinear), the acceptance bar
+    result["device_latency_ratio_max_vs_min_window"] = round(
+        qs[w_hi]["device_median_ms"] / qs[w_lo]["device_median_ms"], 2
+    )
+    result["window_ratio"] = windows[-1] / windows[0]
+    result["host_loop_ratio_max_vs_min_window"] = round(
+        qs[w_hi]["host_loop_merge_ms"]
+        / max(qs[w_lo]["host_loop_merge_ms"], 1e-6), 2
+    )
+    return result
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--metrics", type=int, default=1024)
+    parser.add_argument("--bucket-limit", type=int, default=4_096)
+    parser.add_argument("--slots", type=int, default=64)
+    parser.add_argument("--reps", type=int, default=5)
+    parser.add_argument("--tpu", action="store_true",
+                        help="keep the configured (TPU) platform instead "
+                             "of forcing CPU")
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args(argv)
+
+    import jax
+
+    if not args.tpu:
+        jax.config.update("jax_platforms", "cpu")
+    result = run(num_metrics=args.metrics, bucket_limit=args.bucket_limit,
+                 slots=args.slots, reps=args.reps)
+    text = json.dumps(result, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -73,6 +73,12 @@ class RawMetricSet:
 
     histograms maps name -> {bucket_index: count} — sparse, full int16
     span, exactly mergeable across systems/hosts by elementwise addition.
+
+    ``duration`` is the collection interval in seconds (None for sets
+    built before this field existed, e.g. old journal lines).  Rates are
+    per-interval deltas, so any consumer doing per-second math (burn
+    rates, replayed-history rates in the timewheel) needs the real
+    duration, not an assumed live interval.
     """
 
     time: _dt.datetime
@@ -80,6 +86,7 @@ class RawMetricSet:
     rates: Dict[str, int]
     histograms: Dict[str, Dict[int, int]]
     gauges: Dict[str, float]
+    duration: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -112,12 +119,16 @@ def merge_raw_metric_sets(a: RawMetricSet, b: RawMetricSet) -> RawMetricSet:
         )
     gauges = dict(a.gauges)
     gauges.update(b.gauges)
+    # same-interval merges (the intended use) keep the shared duration;
+    # mismatched durations can't be reconciled, so drop to unknown
+    duration = a.duration if a.duration == b.duration else None
     return RawMetricSet(
         time=min(a.time, b.time),
         counters=counters,
         rates=rates,
         histograms=histograms,
         gauges=gauges,
+        duration=duration,
     )
 
 
@@ -890,6 +901,7 @@ class MetricSystem:
             rates=rates,
             histograms=histograms,
             gauges=gauges,
+            duration=self.interval,
         )
 
     # ------------------------------------------------------------------ #

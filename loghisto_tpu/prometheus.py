@@ -12,17 +12,27 @@ names (`lat_99.9`) are emitted as one `summary`-style family with
 
     from loghisto_tpu.prometheus import PrometheusEndpoint
     PrometheusEndpoint(ms, port=9464).start()   # GET /metrics
+
+With a retention wheel the endpoint also serves sliding-window tails —
+``<metric>_w5m{quantile="0.99"}`` — computed fresh per scrape from the
+timewheel (one fused device reduction per configured window):
+
+    ms = TPUMetricSystem(retention=True)
+    PrometheusEndpoint(ms, wheel=ms.retention).start()
 """
 
 from __future__ import annotations
 
 import http.server
+import logging
 import re
 import threading
 from typing import Optional
 
 from loghisto_tpu.channel import ChannelClosed, ResilientSubscription
 from loghisto_tpu.metrics import MetricSystem, ProcessedMetricSet
+
+logger = logging.getLogger("loghisto_tpu")
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _QUANTILE_SUFFIX = re.compile(r"^(.*)_(50|75|90|95|99|99\.9|99\.99)$")
@@ -78,23 +88,86 @@ def prometheus_exposition(
     return ("\n".join(lines) + "\n").encode()
 
 
+def _window_label(seconds: float) -> str:
+    """300 -> "5m", 3600 -> "1h", 90 -> "90s" — the window tag in
+    ``<metric>_w5m`` family names."""
+    s = int(seconds)
+    if s >= 3600 and s % 3600 == 0:
+        return f"{s // 3600}h"
+    if s >= 60 and s % 60 == 0:
+        return f"{s // 60}m"
+    return f"{s}s"
+
+
+def windowed_exposition(
+    wheel,
+    windows: tuple[float, ...] = (300.0,),
+    quantiles: tuple[float, ...] = (0.5, 0.9, 0.99),
+    pattern: str = "*",
+) -> bytes:
+    """Serialize sliding-window statistics from a TimeWheel: one summary
+    family per (metric, window) — ``<metric>_w5m{quantile="0.99"}`` plus
+    ``_count``/``_sum`` siblings — each window one fused device query.
+    The window tag keeps families disjoint from the last-interval
+    summaries prometheus_exposition emits for the same metric."""
+    lines: list[str] = []
+    for window in windows:
+        label = _window_label(window)
+        res = wheel.query(pattern, window, percentiles=quantiles)
+        for name, entry in sorted(res.metrics.items()):
+            family = f"{_sanitize(name)}_w{label}"
+            lines.append(f"# TYPE {family} summary")
+            for q in quantiles:
+                key = f"{q * 100:.4f}".rstrip("0").rstrip(".")
+                value = entry[f"p{key}"]
+                lines.append(f'{family}{{quantile="{q:g}"}} {value}')
+            lines.append(f"{family}_count {entry['count']}")
+            lines.append(f"{family}_sum {entry['sum']}")
+    if not lines:
+        return b""
+    return ("\n".join(lines) + "\n").encode()
+
+
 class PrometheusEndpoint:
     """Pull endpoint: subscribes to processed metrics, caches the latest
-    interval, and serves it at GET /metrics."""
+    interval, and serves it at GET /metrics.
+
+    With ``wheel=`` (a window.TimeWheel) each scrape also serves
+    wheel-backed sliding-window quantiles (`<metric>_w5m{quantile=...}`)
+    computed at scrape time, so the pull side sees live window tails,
+    not just last-interval values."""
 
     def __init__(
         self,
         metric_system: MetricSystem,
         port: int = 9464,
         host: str = "0.0.0.0",
+        wheel=None,
+        windows: tuple[float, ...] = (300.0,),
+        window_quantiles: tuple[float, ...] = (0.5, 0.9, 0.99),
     ):
         self._ms = metric_system
         self._addr = (host, port)
+        self._wheel = wheel
+        self._windows = tuple(windows)
+        self._window_quantiles = tuple(window_quantiles)
         self._sub: Optional[ResilientSubscription] = None
         self._latest: bytes = b"# no interval collected yet\n"
         self._latest_lock = threading.Lock()
         self._server: Optional[http.server.ThreadingHTTPServer] = None
         self._threads: list[threading.Thread] = []
+
+    def _windowed_payload(self) -> bytes:
+        if self._wheel is None:
+            return b""
+        try:
+            return windowed_exposition(
+                self._wheel, self._windows, self._window_quantiles
+            )
+        except Exception:
+            logger.exception("windowed exposition failed; serving "
+                             "last-interval metrics only")
+            return b""
 
     @property
     def port(self) -> int:
@@ -115,6 +188,7 @@ class PrometheusEndpoint:
                     return
                 with endpoint._latest_lock:
                     payload = endpoint._latest
+                payload += endpoint._windowed_payload()
                 self.send_response(200)
                 self.send_header(
                     "Content-Type", "text/plain; version=0.0.4"

@@ -11,16 +11,28 @@ device-side statistics.
     ms.histogram("rpc_latency", 1234.5)        # host path, as ever
     ms.record_batch(ids, values)               # firehose path, batched
     pms = ms.device_metrics()                  # percentiles computed on TPU
+
+With ``retention=`` a TimeWheel subscribes alongside the aggregator,
+keeping sliding-window history on device and powering the rule engine:
+
+    ms = TPUMetricSystem(interval=1.0, retention=True)
+    ms.start()
+    ms.query_window("rpc_latency", window=300)          # p99 over 5m
+    ms.add_rule(SloBurnRateRule("api_slo", "errors", "requests",
+                                objective=0.999, long_window=3600,
+                                short_window=300))
+    ms.subscribe_to_alerts(ch)                          # Alert events
 """
 
 from __future__ import annotations
 
-from typing import Mapping
+from typing import Iterable, Mapping, Optional, Sequence
 
 import numpy as np
 
+from loghisto_tpu.channel import Channel
 from loghisto_tpu.config import DEFAULT_PERCENTILES, MetricConfig
-from loghisto_tpu.metrics import MetricSystem, ProcessedMetricSet
+from loghisto_tpu.metrics import MetricSystem, ProcessedMetricSet, RawMetricSet
 from loghisto_tpu.parallel.aggregator import TPUAggregator
 
 
@@ -35,7 +47,15 @@ class TPUMetricSystem(MetricSystem):
         mesh=None,
         native_staging: bool = False,
         fast_ingest: bool = False,
+        retention=None,
     ):
+        """``retention`` turns on the windowed retention tier:
+        ``True`` builds a TimeWheel with the default 60x1 / 60x60 /
+        24x3600 tiers, a sequence of ``(slots, res)`` pairs builds one
+        with those tiers, and a ready ``TimeWheel`` instance is attached
+        as-is (it must share this system's registry for consistent row
+        ids).  The wheel subscribes behind the same raw boundary as the
+        aggregator and shares its registry and mesh."""
         super().__init__(
             interval=interval, sys_stats=sys_stats, config=config,
             fast_ingest=fast_ingest,
@@ -50,6 +70,31 @@ class TPUMetricSystem(MetricSystem):
         self.aggregator.attach(self)
         self.aggregator.register_device_gauges(self)
 
+        self.retention = None
+        self.rule_engine = None
+        if retention is not None and retention is not False:
+            from loghisto_tpu.window import (
+                DEFAULT_TIERS, RuleEngine, TimeWheel,
+            )
+
+            if isinstance(retention, TimeWheel):
+                self.retention = retention
+            else:
+                tiers = (
+                    DEFAULT_TIERS if retention is True else retention
+                )
+                self.retention = TimeWheel(
+                    num_metrics=num_metrics,
+                    config=config,
+                    interval=interval,
+                    tiers=tiers,
+                    registry=self.aggregator.registry,
+                    mesh=mesh,
+                )
+            self.retention.attach(self)
+            self.rule_engine = RuleEngine(self.retention)
+            self.rule_engine.attach()
+
     def record_batch(self, ids: np.ndarray, values: np.ndarray) -> None:
         """Batched firehose ingestion straight to the device accumulator
         (bypasses the host sparse tier; ids come from metric_id())."""
@@ -63,13 +108,71 @@ class TPUMetricSystem(MetricSystem):
         """Device-side statistics for everything aggregated so far."""
         return self.aggregator.collect(reset=reset)
 
+    # ------------------------------------------------------------------ #
+    # windowed retention & rules (requires retention=)
+    # ------------------------------------------------------------------ #
+
+    def _require_retention(self):
+        if self.retention is None:
+            raise RuntimeError(
+                "windowed queries/rules need retention: construct with "
+                "TPUMetricSystem(retention=True) (or tiers/a TimeWheel)"
+            )
+        return self.retention
+
+    def query_window(
+        self,
+        pattern: str = "*",
+        window: Optional[float] = None,
+        percentiles: Optional[Sequence[float]] = None,
+        tier: Optional[int] = None,
+    ):
+        """Sliding-window statistics over the retention wheel — one fused
+        device reduction; see TimeWheel.query."""
+        return self._require_retention().query(
+            pattern, window, percentiles, tier
+        )
+
+    def window_rate(self, name: str, window: float) -> float:
+        """Counter rate (events/s) over the trailing window."""
+        return self._require_retention().window_rate(name, window)
+
+    def add_rule(self, rule):
+        """Register an alerting rule (window.rules.*Rule), evaluated
+        after every interval; its state gauges join this system's
+        exporters immediately."""
+        self._require_retention()
+        self.rule_engine.add(rule)
+        self.rule_engine.register_gauges(self)
+        return rule
+
+    def subscribe_to_alerts(self, ch: Channel) -> None:
+        self._require_retention()
+        self.rule_engine.subscribe(ch)
+
+    def unsubscribe_from_alerts(self, ch: Channel) -> None:
+        if self.rule_engine is not None:
+            self.rule_engine.unsubscribe(ch)
+
+    def backfill_retention(self, intervals: Iterable[RawMetricSet]) -> int:
+        """Replay journaled intervals (utils.journal.replay(path)) into
+        the retention wheel — offline reconstruction of window state.
+        Returns the number of intervals pushed."""
+        return self._require_retention().backfill(intervals)
+
+    # ------------------------------------------------------------------ #
+
     def start(self) -> None:
         # restartable like the base class: re-attach the device bridge if a
-        # previous stop() detached it
+        # previous stop() detached it (same for the retention wheel)
         if self.aggregator._attached is None:
             self.aggregator.attach(self)
+        if self.retention is not None and self.retention._thread is None:
+            self.retention.attach(self)
         super().start()
 
     def stop(self) -> None:
         self.aggregator.detach()
+        if self.retention is not None:
+            self.retention.detach()
         super().stop()

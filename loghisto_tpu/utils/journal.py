@@ -36,7 +36,7 @@ class JournalVersionError(Exception):
 
 
 def dump_line(raw: RawMetricSet) -> str:
-    return json.dumps({
+    obj = {
         "v": FORMAT_VERSION,
         "time": raw.time.timestamp(),
         "counters": raw.counters,
@@ -47,7 +47,14 @@ def dump_line(raw: RawMetricSet) -> str:
             for name, buckets in raw.histograms.items()
         },
         "gauges": raw.gauges,
-    }, separators=(",", ":"))
+    }
+    # interval duration (seconds): rates are per-interval deltas, so
+    # replay-time rate/burn-rate math needs the real denominator instead
+    # of assuming the replaying system's live interval.  Optional key —
+    # same format version, and old lines replay with duration=None.
+    if raw.duration is not None:
+        obj["interval"] = raw.duration
+    return json.dumps(obj, separators=(",", ":"))
 
 
 def parse_line(line: str) -> RawMetricSet:
@@ -69,6 +76,10 @@ def parse_line(line: str) -> RawMetricSet:
         # coerced like the other fields so a corrupt gauges value fails
         # HERE (inside replay's skip-and-warn net), not at the consumer
         gauges={k: float(v) for k, v in obj["gauges"].items()},
+        duration=(
+            float(obj["interval"]) if obj.get("interval") is not None
+            else None
+        ),
     )
 
 

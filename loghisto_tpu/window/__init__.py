@@ -1,0 +1,41 @@
+"""Windowed retention & rules: the timewheel subsystem.
+
+Device-resident sliding-window retention (store.TimeWheel), fused
+window-merge/CDF kernels (ops/window.py), and the rule engine
+(rules.RuleEngine) that alerts on windowed statistics and SLO burn
+rates.  Wired into TPUMetricSystem via ``retention=``.
+"""
+
+from loghisto_tpu.window.rules import (
+    Alert,
+    FIRING,
+    RESOLVED,
+    RateOfChangeRule,
+    Rule,
+    RuleEngine,
+    SloBurnRateRule,
+    ThresholdRule,
+)
+from loghisto_tpu.window.store import (
+    DEFAULT_TIERS,
+    TierSpec,
+    TimeWheel,
+    WindowStats,
+    pct_key,
+)
+
+__all__ = [
+    "Alert",
+    "DEFAULT_TIERS",
+    "FIRING",
+    "RESOLVED",
+    "RateOfChangeRule",
+    "Rule",
+    "RuleEngine",
+    "SloBurnRateRule",
+    "ThresholdRule",
+    "TierSpec",
+    "TimeWheel",
+    "WindowStats",
+    "pct_key",
+]

@@ -1,0 +1,512 @@
+"""TimeWheel: device-resident windowed retention store.
+
+The live stack aggregates one interval at a time and the data is gone
+after broadcast; the wheel is the retention tier that makes "p99 over the
+last 5 minutes" a device primitive.  It subscribes behind the existing
+Raw/Processed boundary (attach(), same contract as TPUAggregator) and
+keeps, per resolution tier, a device-resident ring of dense
+``int32[slots, num_metrics, num_buckets]`` interval histograms plus
+host-side per-slot counter-delta and duration vectors.
+
+Multi-resolution tiers (default 60 slots x 1 interval, 60 x 1min,
+24 x 1h in units of the base interval): every interval's bucket cells
+scatter into each tier's open slot, so tier "promotion" IS a
+bucket-tensor add — the log-bucket representation merges exactly under
+addition, which is why downsampling loses nothing but slot-boundary
+resolution (total counts are preserved bit-for-bit; the property test in
+tests/test_window.py pins this).
+
+``query(pattern, window, percentiles)`` picks the finest tier covering
+the window and runs ONE fused device reduction over the ring axis
+(ops/window.py) — no per-interval host loop, cost independent of window
+length.  Under a ("stream", "metric") mesh the rings are laid out
+metric-row-sharded and the reduction partitions row-wise with zero
+collectives.
+
+HBM budget: ``sum(tier.slots) * num_metrics * num_buckets * 4`` bytes
+(``hbm_bytes()``); size ``bucket_limit``/tiers to the deployment — the
+wheel takes its own MetricConfig so retention can run a narrower bucket
+range than the live accumulator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import fnmatch
+import logging
+import math
+import threading
+from typing import Callable, Dict, Iterable, List, Mapping, Optional, \
+    NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from loghisto_tpu.config import MetricConfig
+from loghisto_tpu.channel import ChannelClosed, ResilientSubscription
+from loghisto_tpu.metrics import MetricSystem, RawMetricSet
+from loghisto_tpu.ops.window import make_window_stats_fn, resolve_merge_path
+from loghisto_tpu.registry import MetricRegistry, RegistryFullError
+
+logger = logging.getLogger("loghisto_tpu")
+
+# Fixed scatter launch width (same design as the aggregator's bridge
+# merges): one compiled executable per tier serves every interval.
+_CELL_CHUNK = 1 << 16
+
+# drop sentinel: far out of row range, every scatter mode="drop" sheds it
+_DROP_ID = np.int32(2**30)
+
+
+class TierSpec(NamedTuple):
+    """One retention tier: ``slots`` ring entries of ``res`` base
+    intervals each (res=1 -> per-interval, res=60 at a 1s interval ->
+    per-minute)."""
+
+    slots: int
+    res: int
+
+
+DEFAULT_TIERS: tuple[TierSpec, ...] = (
+    TierSpec(60, 1),      # e.g. 60 x 1s
+    TierSpec(60, 60),     # 60 x 1m
+    TierSpec(24, 3600),   # 24 x 1h
+)
+
+DEFAULT_QUERY_PERCENTILES: tuple[float, ...] = (0.5, 0.9, 0.99, 0.999)
+
+
+def pct_key(q: float) -> str:
+    """0.99 -> "p99", 0.999 -> "p99.9", 0.5 -> "p50"."""
+    s = f"{q * 100:.4f}".rstrip("0").rstrip(".")
+    return f"p{s}"
+
+
+@dataclasses.dataclass
+class WindowStats:
+    """Result of one window query: per-metric stat dicts
+    ({"count", "sum", "avg", "p50", ...}) plus what was actually
+    covered (the wheel clamps to retained history)."""
+
+    time: _dt.datetime
+    window_s: float    # requested
+    covered_s: float   # duration actually merged (sum of slot durations)
+    tier: int          # tier index the query ran on
+    slots: int         # ring slots merged
+    metrics: Dict[str, Dict[str, float]]
+
+
+class _Tier:
+    """Host-side state for one resolution tier (device ring + per-slot
+    metadata).  All mutation happens under the wheel's lock."""
+
+    def __init__(self, spec: TierSpec, num_metrics: int, num_buckets: int,
+                 sharding=None):
+        self.spec = spec
+        z = jnp.zeros((spec.slots, num_metrics, num_buckets),
+                      dtype=jnp.int32)
+        self.ring = jax.device_put(z, sharding) if sharding is not None else z
+        self.slot = 0            # open slot index
+        self.in_slot = 0         # intervals landed in the open slot
+        self.written = np.zeros(spec.slots, dtype=bool)
+        self.durations = np.zeros(spec.slots, dtype=np.float64)
+        self.rates: List[Dict[str, int]] = [dict() for _ in range(spec.slots)]
+
+    def span_intervals(self) -> int:
+        return self.spec.slots * self.spec.res
+
+
+def _open_slot(ring, slot):
+    """Zero a slot for reuse (ring wrap).  Donated so the wheel's
+    steady-state never reallocates the ring."""
+    return ring.at[slot].set(0)
+
+
+_open_slot_jit = jax.jit(_open_slot, donate_argnums=0)
+
+
+def _scatter_cells(ring, slot, ids, idx, weights):
+    """Add weighted (row, dense bucket) cells into ring[slot] — the
+    per-interval bucket-tensor add every tier shares."""
+    return ring.at[slot, ids, idx].add(weights, mode="drop")
+
+
+_scatter_cells_jit = jax.jit(_scatter_cells, donate_argnums=0)
+
+
+class TimeWheel:
+    def __init__(
+        self,
+        num_metrics: int = 1024,
+        config: MetricConfig = MetricConfig(),
+        interval: float = 1.0,
+        tiers: Sequence[TierSpec | tuple] = DEFAULT_TIERS,
+        percentiles: Sequence[float] = DEFAULT_QUERY_PERCENTILES,
+        registry: Optional[MetricRegistry] = None,
+        mesh=None,
+        merge_path: str = "auto",
+    ):
+        """``interval`` is the base interval in seconds (one push() per
+        interval); ``tiers`` resolutions are in base intervals and must
+        be strictly increasing.  With ``mesh`` (the aggregator's
+        ("stream", "metric") mesh) rings are metric-row-sharded."""
+        if interval <= 0:
+            raise ValueError("interval must be positive seconds")
+        self.interval = float(interval)
+        self.config = config
+        self.num_metrics = num_metrics
+        self.registry = (
+            registry if registry is not None
+            else MetricRegistry(capacity=num_metrics)
+        )
+        if self.registry.capacity > num_metrics:
+            raise ValueError(
+                f"registry capacity {self.registry.capacity} exceeds the "
+                f"wheel's num_metrics {num_metrics}"
+            )
+        tiers = tuple(TierSpec(*t) for t in tiers)
+        if not tiers:
+            raise ValueError("at least one retention tier is required")
+        for t in tiers:
+            if t.slots < 1 or t.res < 1:
+                raise ValueError(f"invalid tier {t}: slots/res must be >= 1")
+        if any(b.res <= a.res for a, b in zip(tiers, tiers[1:])):
+            raise ValueError(
+                f"tier resolutions must be strictly increasing, got "
+                f"{[t.res for t in tiers]}"
+            )
+        self.percentiles = tuple(float(p) for p in percentiles)
+        if any(not 0.0 <= p <= 1.0 for p in self.percentiles):
+            raise ValueError("percentiles must be in [0, 1]")
+
+        self.mesh = mesh
+        sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from loghisto_tpu.parallel.mesh import METRIC_AXIS
+
+            n_metric = mesh.shape[METRIC_AXIS]
+            if num_metrics % n_metric:
+                raise ValueError(
+                    f"num_metrics={num_metrics} not divisible by the mesh "
+                    f"metric axis ({n_metric})"
+                )
+            sharding = NamedSharding(mesh, P(None, METRIC_AXIS, None))
+        platform = (
+            mesh.devices.flat[0].platform if mesh is not None
+            else jax.default_backend()
+        )
+        self.merge_path = resolve_merge_path(
+            merge_path, platform, mesh is not None
+        )
+        self._stats_fn = make_window_stats_fn(
+            config.bucket_limit, config.precision, self.merge_path
+        )
+
+        self._tiers = [
+            _Tier(t, num_metrics, config.num_buckets, sharding)
+            for t in tiers
+        ]
+        # one lock covers ring refs AND their donation lifecycle: query
+        # runs its device call under it so a concurrent push can never
+        # donate the very ring a query is reading
+        self._lock = threading.Lock()
+        self.intervals_pushed = 0
+        self.samples_retained = 0   # lifetime histogram samples landed
+        self.shed_samples = 0       # registry-full sheds
+        self._last_time: Optional[_dt.datetime] = None
+        self._hooks: List[Callable[[RawMetricSet], None]] = []
+
+        self._sub: Optional[ResilientSubscription] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- sizing --------------------------------------------------------- #
+
+    def hbm_bytes(self) -> int:
+        """Device bytes the rings occupy (per replica when unsharded)."""
+        return sum(
+            t.spec.slots * self.num_metrics * self.config.num_buckets * 4
+            for t in self._tiers
+        )
+
+    @property
+    def tiers(self) -> tuple[TierSpec, ...]:
+        return tuple(t.spec for t in self._tiers)
+
+    # -- ingestion ------------------------------------------------------ #
+
+    def _cells_from_raw(self, raw: RawMetricSet):
+        """Sparse interval histograms -> (row, dense bucket, weight)
+        int32 arrays, registry-resolved, sanitized for drop-mode
+        scatter."""
+        ids, bidx, weights = [], [], []
+        for name, bucket_counts in raw.histograms.items():
+            try:
+                mid = self.registry.id_for(name)
+            except RegistryFullError:
+                n = sum(bucket_counts.values())
+                first = self.shed_samples == 0
+                self.shed_samples += n
+                if first:
+                    logger.warning(
+                        "timewheel registry exhausted at %d names; samples "
+                        "for further new names are shed (shed_samples "
+                        "counts them)", self.registry.capacity,
+                    )
+                continue
+            for bucket, count in bucket_counts.items():
+                ids.append(mid)
+                bidx.append(bucket)
+                weights.append(count)
+        if not ids:
+            return None
+        bl = self.config.bucket_limit
+        ids_np = np.asarray(ids, dtype=np.int32)
+        idx_np = (
+            np.clip(np.asarray(bidx, dtype=np.int64), -bl, bl) + bl
+        ).astype(np.int32)
+        # int32 wire: counts above 2^31-1 in ONE sparse cell are outside
+        # the wheel's contract (the live tier's spill handles them; a
+        # retention slot holding >2e9 identical samples is clipped)
+        weights_np = np.minimum(
+            np.asarray(weights, dtype=np.int64), np.int64(2**31 - 1)
+        ).astype(np.int32)
+        return ids_np, idx_np, weights_np
+
+    def push(self, raw: RawMetricSet, duration: Optional[float] = None) -> None:
+        """Land one interval on every tier.  ``duration`` (seconds)
+        defaults to the RawMetricSet's recorded duration (journal replays
+        carry it) and then to the wheel's configured interval."""
+        dur = (
+            float(duration) if duration is not None
+            else float(raw.duration) if raw.duration is not None
+            else self.interval
+        )
+        cells = self._cells_from_raw(raw)
+        with self._lock:
+            self._last_time = raw.time
+            self.intervals_pushed += 1
+            if cells is not None:
+                self.samples_retained += int(cells[2].sum(dtype=np.int64))
+            for tier in self._tiers:
+                self._tier_push_locked(tier, cells, raw.rates, dur)
+        for hook in list(self._hooks):
+            try:
+                hook(raw)
+            except Exception:
+                logger.exception("timewheel interval hook failed")
+
+    def _tier_push_locked(self, tier: _Tier, cells, rates, dur: float):
+        slot = tier.slot
+        if tier.in_slot == 0:
+            # opening the slot: clear its previous life (ring wrap)
+            if tier.written[slot]:
+                tier.ring = _open_slot_jit(tier.ring, np.int32(slot))
+            tier.durations[slot] = 0.0
+            tier.rates[slot] = {}
+        if cells is not None:
+            ids_np, idx_np, weights_np = cells
+            n = len(ids_np)
+            for off in range(0, n, _CELL_CHUNK):
+                take = min(_CELL_CHUNK, n - off)
+                ids_pad = np.full(_CELL_CHUNK, _DROP_ID, dtype=np.int32)
+                idx_pad = np.zeros(_CELL_CHUNK, dtype=np.int32)
+                w_pad = np.zeros(_CELL_CHUNK, dtype=np.int32)
+                ids_pad[:take] = ids_np[off:off + take]
+                idx_pad[:take] = idx_np[off:off + take]
+                w_pad[:take] = weights_np[off:off + take]
+                tier.ring = _scatter_cells_jit(
+                    tier.ring, np.int32(slot), ids_pad, idx_pad, w_pad
+                )
+        tier.written[slot] = True
+        tier.durations[slot] += dur
+        slot_rates = tier.rates[slot]
+        for name, delta in rates.items():
+            slot_rates[name] = slot_rates.get(name, 0) + delta
+        tier.in_slot += 1
+        if tier.in_slot >= tier.spec.res:
+            tier.slot = (slot + 1) % tier.spec.slots
+            tier.in_slot = 0
+
+    def backfill(self, intervals: Iterable[RawMetricSet]) -> int:
+        """Replay intervals (e.g. ``utils.journal.replay(path)``) into
+        the wheel — offline reconstruction of the retention state.  Each
+        interval's journaled duration drives the rate math; returns the
+        number of intervals pushed."""
+        n = 0
+        for raw in intervals:
+            self.push(raw)
+            n += 1
+        return n
+
+    # -- queries -------------------------------------------------------- #
+
+    def _select_tier(self, needed_intervals: int) -> int:
+        for i, tier in enumerate(self._tiers):
+            if tier.span_intervals() >= needed_intervals:
+                return i
+        return len(self._tiers) - 1
+
+    def _mask_locked(self, tier: _Tier, window_s: float) -> np.ndarray:
+        """Boolean mask over ring slots covering the trailing window:
+        walk back from the open slot accumulating RECORDED slot
+        durations until the window is covered.  Duration-driven (not
+        nominal-interval-driven) so replayed history at a different
+        cadence — e.g. a journal of 0.5s intervals backfilled into a 1s
+        wheel — still answers "the trailing W seconds" correctly."""
+        mask = np.zeros(tier.spec.slots, dtype=bool)
+        slot = tier.slot if tier.in_slot > 0 else (
+            (tier.slot - 1) % tier.spec.slots
+        )
+        covered = 0.0
+        for _ in range(tier.spec.slots):
+            if not tier.written[slot] or mask[slot]:
+                break
+            mask[slot] = True
+            covered += float(tier.durations[slot])
+            if covered >= window_s - 1e-9:
+                break
+            slot = (slot - 1) % tier.spec.slots
+        return mask
+
+    def query(
+        self,
+        pattern: str = "*",
+        window: Optional[float] = None,
+        percentiles: Optional[Sequence[float]] = None,
+        tier: Optional[int] = None,
+    ) -> WindowStats:
+        """Sliding-window statistics for every metric matching the glob
+        ``pattern`` over the trailing ``window`` seconds.
+
+        Picks the finest tier covering the window (override with
+        ``tier``), merges the covered ring slots in one fused device
+        reduction, and extracts counts/sums/percentiles for all rows in
+        the same program.  The open (partial) slot is included, so the
+        window's trailing edge is live."""
+        ps = tuple(
+            float(p) for p in (
+                percentiles if percentiles is not None else self.percentiles
+            )
+        )
+        if any(not 0.0 <= p <= 1.0 for p in ps):
+            raise ValueError("percentiles must be in [0, 1]")
+        if window is None:
+            window = self._tiers[-1].span_intervals() * self.interval
+        needed = max(1, math.ceil(window / self.interval))
+        ti = self._select_tier(needed) if tier is None else int(tier)
+        if not 0 <= ti < len(self._tiers):
+            raise ValueError(f"tier {ti} out of range")
+        t = self._tiers[ti]
+        ps_arr = np.asarray(ps, dtype=np.float32)
+        with self._lock:
+            mask = self._mask_locked(t, float(window))
+            covered = float(t.durations[mask].sum())
+            ts = self._last_time or _dt.datetime.now(tz=_dt.timezone.utc)
+            # the device call stays under the lock: a concurrent push
+            # would otherwise donate the ring buffer out from under it
+            stats = self._stats_fn(t.ring, mask, ps_arr)
+            counts = np.asarray(stats["counts"])
+            sums = np.asarray(stats["sums"])
+            pcts = np.asarray(stats["percentiles"])
+        names = self.registry.names()
+        keys = [pct_key(p) for p in ps]
+        metrics: Dict[str, Dict[str, float]] = {}
+        for mid, name in enumerate(names):
+            if mid >= len(counts) or not fnmatch.fnmatch(name, pattern):
+                continue
+            count = int(counts[mid])
+            if count == 0:
+                continue
+            entry = {
+                "count": float(count),
+                "sum": float(sums[mid]),
+                "avg": float(sums[mid]) / count,
+            }
+            for key, value in zip(keys, pcts[mid]):
+                entry[key] = float(value)
+            metrics[name] = entry
+        return WindowStats(
+            time=ts,
+            window_s=float(window),
+            covered_s=covered,
+            tier=ti,
+            slots=int(mask.sum()),
+            metrics=metrics,
+        )
+
+    def window_counter(
+        self, name: str, window: float, tier: Optional[int] = None
+    ) -> tuple[int, float]:
+        """(sum of counter deltas, covered seconds) for ``name`` over the
+        trailing window — the burn-rate primitive.  Counter deltas live
+        in host per-slot vectors (they are O(names), not O(buckets));
+        the covered duration uses the journaled per-interval durations,
+        so replayed history keeps its real rate denominators."""
+        needed = max(1, math.ceil(window / self.interval))
+        ti = self._select_tier(needed) if tier is None else int(tier)
+        t = self._tiers[ti]
+        with self._lock:
+            mask = self._mask_locked(t, float(window))
+            total = sum(
+                t.rates[i].get(name, 0)
+                for i in np.nonzero(mask)[0]
+            )
+            covered = float(t.durations[mask].sum())
+        return int(total), covered
+
+    def window_rate(self, name: str, window: float) -> float:
+        """Counter rate (events/s) over the trailing window; 0 when the
+        wheel has no covered history yet."""
+        total, covered = self.window_counter(name, window)
+        return total / covered if covered > 0 else 0.0
+
+    # -- subscription bridge ------------------------------------------- #
+
+    def add_interval_hook(self, fn: Callable[[RawMetricSet], None]) -> None:
+        """Run ``fn(raw)`` after every pushed interval (rule-engine
+        attachment point).  Hooks run on the pushing thread."""
+        self._hooks.append(fn)
+
+    def attach(self, ms: MetricSystem, channel_capacity: int = 16) -> None:
+        """Subscribe behind the raw boundary: every broadcast interval
+        lands on the wheel via a bridge thread.  Strike-eviction
+        resilient (ResilientSubscription), same recovery contract as the
+        journal/exporters."""
+        if self._thread is not None:
+            raise RuntimeError("already attached")
+        self._sub = ResilientSubscription(
+            ms.subscribe_to_raw_metrics,
+            ms.unsubscribe_from_raw_metrics,
+            channel_capacity,
+        )
+        sub = self._sub
+
+        def bridge():
+            while True:
+                try:
+                    raw = sub.get()
+                except ChannelClosed:
+                    return
+                try:
+                    self.push(raw)
+                except Exception:  # pragma: no cover - defensive
+                    logger.exception(
+                        "timewheel push failed for interval %s", raw.time
+                    )
+
+        self._thread = threading.Thread(
+            target=bridge, daemon=True, name="loghisto-timewheel"
+        )
+        self._thread.start()
+
+    def detach(self) -> None:
+        if self._sub is not None:
+            self._sub.close()
+            self._sub = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

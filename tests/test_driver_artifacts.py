@@ -25,6 +25,42 @@ def test_bench_main_emits_one_json_line(monkeypatch, capsys):
     assert payload["unit"] == "samples/s"
 
 
+def test_bench_plausibility_guard_refuses_impossible_rates(
+    monkeypatch, capsys
+):
+    import bench
+    import benchmarks.h2d_bench as h2d
+
+    monkeypatch.setattr(
+        h2d, "run", lambda **kw: {"value": 1.0, "transport": "stub"}
+    )
+    # the 31T samples/s class of broken timing (async backend acking
+    # before execution) must be withheld, not reported as the headline
+    monkeypatch.setattr(bench, "measure_headline", lambda *a, **k: {
+        "samples_per_s": 3.1e13, "elapsed_s": 1e-4, "samples": 1,
+        "ingest_path": "stub", "percentile_query_p99_us": 1.0,
+        "percentile_query_median_us": 1.0,
+    })
+    bench.main()
+    payload = json.loads(capsys.readouterr().out.strip())
+    assert payload["suspect"] is True
+    assert payload["value"] is None
+    assert payload["vs_baseline"] is None
+    assert payload["measured_samples_per_s"] == pytest.approx(3.1e13)
+    assert payload["plausibility_cap_samples_per_s"] > 0
+
+
+def test_plausibility_cap_scales_with_accumulator():
+    import bench
+
+    vmem = 128 * 1024 * 1024
+    assert bench.plausibility_cap_samples_per_s("tpu", vmem) == 4e12 / 8
+    assert bench.plausibility_cap_samples_per_s("tpu", vmem + 1) == 4e12 / 16
+    assert bench.plausibility_cap_samples_per_s("cpu", 1 << 30) == 4e11 / 16
+    # unknown platforms get the accelerator ceiling, not a free pass
+    assert bench.plausibility_cap_samples_per_s("rocm", 1 << 10) == 4e12 / 8
+
+
 def test_graft_entry_compiles_and_runs():
     import __graft_entry__ as g
 

@@ -21,6 +21,23 @@ def test_full_stack_example_runs():
     assert "journal:" in out and "checkpoint at" in out
 
 
+def test_slo_alerts_example_runs():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "slo_alerts.py")],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    assert "backfilled 90 intervals" in out
+    # the burn-rate rule demonstrably fires on the regression and
+    # resolves after the rollback (ISSUE 1 acceptance)
+    assert "FIRING   api_availability" in out
+    assert "RESOLVED api_availability" in out
+    assert "FIRING   api_latency_p99" in out
+    assert "active alerts: none" in out
+    assert 'api_latency_w1m{quantile="0.99"}' in out
+
+
 def test_migrate_from_go_example_runs():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", "migrate_from_go.py")],

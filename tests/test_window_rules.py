@@ -1,0 +1,315 @@
+"""Rule engine + system wiring: threshold/rate-of-change/burn-rate
+state machines, alert channel delivery, exporter gauges, windowed
+Prometheus exposition, TPUMetricSystem(retention=) end to end."""
+
+import datetime as dt
+import time
+
+import numpy as np
+import pytest
+
+from loghisto_tpu.channel import Channel
+from loghisto_tpu.config import MetricConfig
+from loghisto_tpu.metrics import MetricSystem, RawMetricSet
+from loghisto_tpu.ops.codec import compress_np
+from loghisto_tpu.window import (
+    FIRING,
+    RESOLVED,
+    RateOfChangeRule,
+    RuleEngine,
+    SloBurnRateRule,
+    ThresholdRule,
+    TierSpec,
+    TimeWheel,
+)
+
+pytestmark = pytest.mark.window
+
+T0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+CFG = MetricConfig(bucket_limit=512)
+
+
+def _wheel(slots=32):
+    return TimeWheel(num_metrics=8, config=CFG, interval=1.0,
+                     tiers=[TierSpec(slots, 1)])
+
+
+def _raw(i, values=None, rates=None):
+    hists = {}
+    for name, v in (values or {}).items():
+        ub, cnt = np.unique(
+            compress_np(np.asarray(v, dtype=np.float64), CFG.precision),
+            return_counts=True,
+        )
+        hists[name] = {int(b): int(c) for b, c in zip(ub, cnt)}
+    return RawMetricSet(
+        time=T0 + dt.timedelta(seconds=i), counters={},
+        rates=dict(rates or {}), histograms=hists, gauges={}, duration=1.0,
+    )
+
+
+def test_threshold_rule_fires_and_resolves():
+    wheel = _wheel()
+    engine = RuleEngine(wheel)
+    engine.add(ThresholdRule("hot", "lat", "p99", window=4.0,
+                             threshold=100.0))
+    engine.attach()
+    events = []
+    for i in range(4):
+        wheel.push(_raw(i, {"lat": [10.0] * 50}))
+    assert engine.active() == []
+    for i in range(4, 10):
+        wheel.push(_raw(i, {"lat": [500.0] * 50}))
+    assert engine.active() == ["hot"]
+    for i in range(10, 16):  # slow values age out of the 4s window
+        wheel.push(_raw(i, {"lat": [10.0] * 50}))
+    assert engine.active() == []
+    states = [a.state for a in engine.history]
+    assert states == [FIRING, RESOLVED]
+
+
+def test_threshold_rule_count_stat_and_below_op():
+    wheel = _wheel()
+    engine = RuleEngine(wheel)
+    # alert when traffic DROPS: fewer than 20 samples in the window
+    engine.add(ThresholdRule("starved", "lat", "count", window=2.0,
+                             threshold=20.0, op="<"))
+    wheel.push(_raw(0, {"lat": [5.0] * 100}))
+    assert engine.evaluate(T0) == []
+    wheel.push(_raw(1, {"lat": [5.0] * 3}))
+    wheel.push(_raw(2, {"lat": [5.0] * 3}))
+    alerts = engine.evaluate(T0)
+    assert [a.state for a in alerts] == [FIRING]
+
+
+def test_for_intervals_debounce():
+    wheel = _wheel()
+    engine = RuleEngine(wheel)
+    engine.add(ThresholdRule("flappy", "lat", "avg", window=1.0,
+                             threshold=100.0, for_intervals=3))
+    wheel.push(_raw(0, {"lat": [500.0]}))
+    assert engine.evaluate(T0) == [] and engine.evaluate(T0) == []
+    assert [a.state for a in engine.evaluate(T0)] == [FIRING]
+
+
+def test_empty_wheel_does_not_page():
+    wheel = _wheel()
+    engine = RuleEngine(wheel)
+    engine.add(ThresholdRule("t", "lat", "p99", 5.0, 1.0))
+    engine.add(SloBurnRateRule("s", "err", "req", 0.99, 8.0, 2.0))
+    engine.add(RateOfChangeRule("r", "req", 2.0, 1.0))
+    assert engine.evaluate(T0) == []
+    assert engine.active() == []
+
+
+def test_rate_of_change_rule():
+    wheel = _wheel()
+    engine = RuleEngine(wheel)
+    engine.add(RateOfChangeRule("spike", "req", window=2.0,
+                                threshold=50.0))
+    for i in range(4):
+        wheel.push(_raw(i, rates={"req": 100}))
+    assert engine.evaluate(T0) == []  # flat traffic
+    for i in range(4, 6):
+        wheel.push(_raw(i, rates={"req": 300}))
+    # trailing 2s at 300/s vs prior 2s at 100/s: delta 200/s > 50/s
+    assert [a.state for a in engine.evaluate(T0)] == [FIRING]
+
+
+def test_slo_burn_rate_requires_both_windows():
+    wheel = _wheel()
+    rule = SloBurnRateRule("slo", "err", "req", objective=0.99,
+                           long_window=8.0, short_window=2.0,
+                           threshold=10.0)
+    engine = RuleEngine(wheel)
+    engine.add(rule)
+    # sustained 50% errors: burn = 0.5/0.01 = 50x on both windows
+    for i in range(8):
+        wheel.push(_raw(i, rates={"req": 100, "err": 50}))
+    assert [a.state for a in engine.evaluate(T0)] == [FIRING]
+    assert rule.long_burn > 10.0 and rule.short_burn > 10.0
+    # errors stop: the short window clears first and resolves the page
+    # even while the long window still carries the outage
+    for i in range(8, 12):
+        wheel.push(_raw(i, rates={"req": 100, "err": 0}))
+    assert [a.state for a in engine.evaluate(T0)] == [RESOLVED]
+    assert rule.long_burn > 10.0 and rule.short_burn == 0.0
+
+
+def test_slo_burn_rate_validation():
+    with pytest.raises(ValueError):
+        SloBurnRateRule("x", "e", "t", objective=1.5, long_window=10,
+                        short_window=1)
+    with pytest.raises(ValueError):
+        SloBurnRateRule("x", "e", "t", objective=0.99, long_window=1,
+                        short_window=10)
+    with pytest.raises(ValueError):
+        ThresholdRule("x", "m", "p150", 1.0, 1.0)
+    with pytest.raises(ValueError):
+        ThresholdRule("x", "m", "bogus", 1.0, 1.0)
+    with pytest.raises(ValueError):
+        ThresholdRule("x", "m", "p99", 1.0, 1.0, op="!=")
+
+
+def test_alert_channel_delivery_and_eviction():
+    wheel = _wheel()
+    engine = RuleEngine(wheel)
+    engine.add(ThresholdRule("hot", "lat", "avg", 1.0, 10.0))
+    ok = Channel(capacity=8)
+    full = Channel(capacity=1)
+    full.offer("stuffed")  # never drained: earns strikes
+    engine.subscribe(ok)
+    engine.subscribe(full)
+    wheel.push(_raw(0, {"lat": [100.0]}))
+    engine.evaluate(T0)
+    wheel.push(_raw(1, {"lat": [1.0]}))
+    engine.evaluate(T0)
+    got = [ok.get(block=False) for _ in range(2)]
+    assert [a.state for a in got] == [FIRING, RESOLVED]
+    # two consecutive failed deliveries evicted + closed the full channel
+    assert full.closed
+    engine.unsubscribe(ok)
+
+
+def test_duplicate_rule_name_rejected():
+    engine = RuleEngine(_wheel())
+    engine.add(ThresholdRule("a", "m", "avg", 1.0, 1.0))
+    with pytest.raises(ValueError):
+        engine.add(ThresholdRule("a", "m", "count", 1.0, 1.0))
+    engine.remove("a")
+    engine.add(ThresholdRule("a", "m", "avg", 1.0, 1.0))
+
+
+def test_failing_rule_does_not_silence_others():
+    wheel = _wheel()
+    engine = RuleEngine(wheel)
+
+    class Boom(ThresholdRule):
+        def observe(self, w):
+            raise RuntimeError("boom")
+
+    engine.add(Boom("bad", "m", "avg", 1.0, 1.0))
+    engine.add(ThresholdRule("good", "lat", "avg", 1.0, 10.0))
+    wheel.push(_raw(0, {"lat": [100.0]}))
+    assert [a.rule for a in engine.evaluate(T0)] == ["good"]
+
+
+def test_engine_gauges_flow_through_metric_system():
+    wheel = _wheel()
+    engine = RuleEngine(wheel)
+    engine.add(ThresholdRule("hot", "lat", "avg", 2.0, 10.0))
+    ms = MetricSystem(interval=60.0, sys_stats=False)
+    engine.register_gauges(ms)
+    wheel.push(_raw(0, {"lat": [100.0]}))
+    engine.evaluate(T0)
+    gauges = ms.collect_raw_metrics().gauges
+    assert gauges["alert.hot"] == 1.0
+    assert gauges["alert.hot.value"] == pytest.approx(100.0, rel=0.02)
+    assert gauges["alerts.firing"] == 1.0
+
+
+def test_windowed_prometheus_exposition():
+    from loghisto_tpu.prometheus import windowed_exposition
+
+    wheel = _wheel()
+    for i in range(10):
+        wheel.push(_raw(i, {"api.lat": [50.0] * 100}))
+    body = windowed_exposition(wheel, windows=(300.0,),
+                               quantiles=(0.5, 0.99)).decode()
+    assert '# TYPE api_lat_w5m summary' in body
+    assert 'api_lat_w5m{quantile="0.99"}' in body
+    assert "api_lat_w5m_count 1000.0" in body
+    # empty wheel serves an empty (not broken) windowed section
+    assert windowed_exposition(_wheel()) == b""
+
+
+def test_window_label_formats():
+    from loghisto_tpu.prometheus import _window_label
+
+    assert _window_label(300) == "5m"
+    assert _window_label(3600) == "1h"
+    assert _window_label(90) == "90s"
+    assert _window_label(60) == "1m"
+
+
+# ---------------------------------------------------------------------- #
+# TPUMetricSystem wiring
+# ---------------------------------------------------------------------- #
+
+def test_system_retention_end_to_end():
+    from loghisto_tpu import TPUMetricSystem
+
+    ms = TPUMetricSystem(interval=0.2, sys_stats=False, config=CFG,
+                         num_metrics=32, retention=[(20, 1), (10, 4)])
+    alerts = Channel(capacity=16)
+    ms.subscribe_to_alerts(alerts)
+    ms.add_rule(ThresholdRule("hot", "lat", "p99", window=2.0,
+                              threshold=50.0))
+    ms.start()
+    try:
+        deadline = time.time() + 20
+        fired = False
+        while time.time() < deadline and not fired:
+            ms.histogram_batch("lat", [120.0] * 200)
+            ms.counter("req", 10)
+            time.sleep(0.1)
+            fired = bool(ms.rule_engine.active())
+        assert fired, "threshold rule never fired on live intervals"
+        res = ms.query_window("lat", window=10.0, percentiles=(0.99,))
+        assert res.metrics["lat"]["p99"] == pytest.approx(120.0, rel=0.02)
+        assert ms.window_rate("req", 10.0) > 0
+        a = alerts.get(timeout=5.0)
+        assert a.rule == "hot" and a.state == FIRING
+        # alert state rides the ordinary gauge path
+        raw = ms.collect_raw_metrics()
+        assert raw.gauges["alert.hot"] == 1.0
+    finally:
+        ms.stop()
+    # stop() detached the wheel; start() re-attaches it (same contract
+    # as the aggregator bridge)
+    assert ms.retention._thread is None
+    ms.start()
+    assert ms.retention._thread is not None
+    ms.stop()
+
+
+def test_system_without_retention_raises():
+    from loghisto_tpu import TPUMetricSystem
+
+    ms = TPUMetricSystem(interval=60.0, sys_stats=False, config=CFG,
+                         num_metrics=8)
+    assert ms.retention is None
+    with pytest.raises(RuntimeError, match="retention"):
+        ms.query_window("x", 1.0)
+    with pytest.raises(RuntimeError, match="retention"):
+        ms.add_rule(ThresholdRule("a", "m", "avg", 1.0, 1.0))
+    ms.stop()
+
+
+def test_system_backfill_retention_from_journal():
+    from loghisto_tpu import TPUMetricSystem
+    from loghisto_tpu.utils.journal import dump_line, parse_line
+
+    ms = TPUMetricSystem(interval=1.0, sys_stats=False, config=CFG,
+                         num_metrics=8, retention=[(30, 1)])
+    try:
+        lines = [dump_line(_raw(i, {"lat": [75.0] * 20},
+                                rates={"req": 40})) for i in range(5)]
+        assert ms.backfill_retention(parse_line(s) for s in lines) == 5
+        res = ms.query_window("lat", window=30.0, percentiles=(0.5,))
+        assert res.metrics["lat"]["count"] == 100
+        assert ms.window_rate("req", 5.0) == pytest.approx(40.0)
+    finally:
+        ms.stop()
+
+
+def test_system_shares_registry_with_wheel():
+    from loghisto_tpu import TPUMetricSystem
+
+    ms = TPUMetricSystem(interval=1.0, sys_stats=False, config=CFG,
+                         num_metrics=8, retention=True)
+    try:
+        mid = ms.metric_id("shared_name")
+        assert ms.retention.registry.id_for("shared_name") == mid
+    finally:
+        ms.stop()
