@@ -9,16 +9,29 @@ MAX_HOURS="${2:-11}"
 # SINGLE INSTANCE: rounds 3-5 each left their 11h watcher running into
 # the next round, so up to four watchers' PJRT init attempts stomped the
 # one tunnel concurrently — every attempt wedged (round 2's lone watcher
-# captured fine).  Kill any other watcher/capture before starting.
-for pid in $(pgrep -f "tpu_watch.sh" 2>/dev/null); do
-  # spare self AND the launching shell (whose cmdline quotes this
-  # script's name when started via bash -c '... tpu_watch.sh ...')
-  [ "$pid" != "$$" ] && [ "$pid" != "$PPID" ] && kill -9 "$pid" 2>/dev/null
-done
-for pid in $(pgrep -f "tpu_oneshot.py" 2>/dev/null); do
-  kill -9 -- "-$pid" 2>/dev/null
-  kill -9 "$pid" 2>/dev/null
-done
+# captured fine).  An flock'd lockfile enforces it now: pgrep -f matched
+# any cmdline QUOTING the script name (editors, tail -f, the launching
+# bash -c) and kill -9'd innocents, and two racing starts could each
+# survive the other's sweep.  The lock is kernel-owned, race-free, and
+# releases itself however this process dies.
+LOCKFILE="benchmarks/.tpu_watch.lock"
+PIDFILE="benchmarks/.tpu_watch.pid"
+exec 200>"$LOCKFILE"
+if ! flock -n 200; then
+  echo "tpu_watch: another watcher holds $LOCKFILE (pid $(cat "$PIDFILE" 2>/dev/null || echo '?')); exiting" >&2
+  exit 1
+fi
+echo "$$" > "$PIDFILE"
+# A previous watcher's capture child can survive its parent (setsid put
+# it in its own process group).  Its pgid is recorded in the pidfile's
+# companion — kill exactly that group, never a pgrep guess.
+CHILDFILE="benchmarks/.tpu_oneshot.pgid"
+if OLDPG=$(cat "$CHILDFILE" 2>/dev/null) && [ -n "$OLDPG" ]; then
+  kill -TERM -- "-$OLDPG" 2>/dev/null
+  sleep 2
+  kill -9 -- "-$OLDPG" 2>/dev/null
+fi
+trap 'rm -f "$PIDFILE" "$CHILDFILE"' EXIT
 DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
 ATTEMPT=0
 # A wedged tunnel hangs PJRT init ~25 min before failing; a HEALTHY init
@@ -56,6 +69,9 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   # the timeout wrapper would orphan it, still holding the device)
   setsid timeout 3900 python benchmarks/tpu_oneshot.py "$OUT" > "$LOG" 2>&1 &
   PID=$!
+  # setsid made the child its own group leader: pgid == pid.  Record it
+  # so the NEXT watcher can reap a survivor without pattern-matching.
+  echo "$PID" > "$CHILDFILE"
   WAITED=0
   while kill -0 "$PID" 2>/dev/null; do
     if [ "$WAITED" -ge "$INIT_TIMEOUT" ] && \
@@ -71,6 +87,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   done
   wait "$PID" 2>/dev/null
   rc=$?
+  rm -f "$CHILDFILE"
   tail -5 "$LOG" 2>/dev/null
   if [ -f "$OUT/SUCCESS" ]; then
     echo "=== CAPTURED on attempt $ATTEMPT; results in $OUT ==="

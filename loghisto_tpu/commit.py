@@ -1,0 +1,457 @@
+"""IntervalCommitter: the single subscription that pays every device
+consumer of an interval with one fused dispatch.
+
+Before this module, a committed interval with retention enabled fanned
+out across two independent bridges: the TPUAggregator's bridge thread
+merged the interval's histograms via its weighted scatter launch, and
+the TimeWheel's bridge re-resolved the same names, rebuilt the same
+cell arrays, and dispatched one scatter per tier — >= 4 device launches
+and >= 4 uploads of the same data per interval, each behind its own
+lock.
+
+The committer replaces both bridges with ONE subscription behind the
+raw boundary:
+
+  1. the interval's sparse histograms are resolved to ``(ids, codec
+     bucket, weight)`` cells ONCE (the aggregator's registry/growth/shed
+     policy applies — the wheel shares the registry by construction);
+  2. the cells are staged through a depth-2 double-buffered H2D ring
+     (``ops.commit.CellStagingRing``) so the next chunk/interval's
+     transfer overlaps the in-flight commit dispatch;
+  3. one jitted donated-carry program (``ops.commit.make_fused_commit_fn``)
+     folds the cells into the aggregator accumulator AND every tier's
+     open slot — slot indices and ring-wrap keep factors ride along as
+     traced int32 operands, so tier rotation never recompiles.
+
+A typical interval is therefore 1 dispatch + 1 upload, bounded at
+ceil(cells / COMMIT_CHUNK) dispatches for pathological cardinality
+(tests/test_commit.py pins the <= 2 dispatch guarantee and bit-identical
+parity with the fan-out path).
+
+Overflow contract: intervals that would break the aggregator's int32
+guarantee (interval total past ``spill_threshold``, or any single cell
+weight >= 2^30) take the aggregator's exact host-spill machinery and
+the wheel's fan-out scatter for that interval — correctness first, the
+fused program only ever runs inside the proven int32 envelope.
+
+Lock ordering: the committer is the only code that holds the
+aggregator's ``_dev_lock`` and the wheel's lock simultaneously, always
+acquired in that order (device state, then wheel state); neither
+subsystem ever takes them in reverse, so the pairing cannot deadlock.
+
+Self-metrics: dispatches/interval, H2D bytes/interval, and a commit
+latency histogram are exported as ``commit.*`` gauges through the
+normal pipeline (``register_gauges``), plus a ``commit.LatencyUs``
+histogram recorded into the attached MetricSystem each interval.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from loghisto_tpu.channel import ChannelClosed, ResilientSubscription
+from loghisto_tpu.metrics import MetricSystem, RawMetricSet
+from loghisto_tpu.ops.commit import (
+    COMMIT_CHUNK,
+    CellStagingRing,
+    make_fused_commit_fn,
+)
+
+logger = logging.getLogger("loghisto_tpu")
+
+
+def commit_incompatibility(aggregator, wheel) -> Optional[str]:
+    """Why this (aggregator, wheel) pair cannot share one fused commit
+    program, or None when it can.  The fused program scatters ONE cell
+    array into both carries, so the pair must agree on row ids (shared
+    registry) and bucket geometry (bucket_limit/precision)."""
+    if aggregator.registry is not wheel.registry:
+        return "aggregator and wheel use different registries"
+    if aggregator.config.bucket_limit != wheel.config.bucket_limit:
+        return (
+            f"bucket_limit mismatch (aggregator "
+            f"{aggregator.config.bucket_limit}, wheel "
+            f"{wheel.config.bucket_limit})"
+        )
+    if aggregator.config.precision != wheel.config.precision:
+        return (
+            f"precision mismatch (aggregator {aggregator.config.precision},"
+            f" wheel {wheel.config.precision})"
+        )
+    return None
+
+
+class IntervalCommitter:
+    """One-subscription interval commit for a (TPUAggregator, TimeWheel)
+    pair — see the module docstring for the design.  ``chunk`` is the
+    fixed commit launch width (tests shrink it to exercise multi-chunk
+    intervals and pad sentinels); ``staging_depth`` sizes the H2D
+    overlap ring."""
+
+    def __init__(
+        self,
+        aggregator,
+        wheel,
+        chunk: int = COMMIT_CHUNK,
+        staging_depth: int = 2,
+    ):
+        reason = commit_incompatibility(aggregator, wheel)
+        if reason is not None:
+            raise ValueError(f"fused commit unavailable: {reason}")
+        self.aggregator = aggregator
+        self.wheel = wheel
+        self.chunk = int(chunk)
+        self._fused = make_fused_commit_fn(len(wheel._tiers))
+        self._staging = CellStagingRing(depth=staging_depth, width=self.chunk)
+
+        # self-metrics (ISSUE 2): per-interval dispatch/H2D accounting
+        # plus a bounded latency reservoir for the percentile gauges
+        self._metrics_lock = threading.Lock()
+        self.intervals_committed = 0
+        self.fused_intervals = 0
+        self.fanout_intervals = 0  # spill or policy fan-outs
+        self.last_dispatches = 0
+        self.last_h2d_bytes = 0
+        self.last_uploads = 0
+        self._latencies_us: deque = deque(maxlen=1024)
+
+        self._ms: Optional[MetricSystem] = None
+        self._sub: Optional[ResilientSubscription] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- cell construction ---------------------------------------------- #
+
+    def _cells_from_raw(self, raw: RawMetricSet):
+        """Sparse interval histograms -> (ids int32, codec bucket int64,
+        weight int64), resolved ONCE through the aggregator's registry
+        policy (growth up to max_metrics, shed past it).  Shed samples
+        are mirrored into the wheel's shed counter so both subsystems'
+        gauges stay truthful with a single bridge."""
+        agg = self.aggregator
+        ids, bidx, weights = [], [], []
+        shed = 0
+        for name, bucket_counts in raw.histograms.items():
+            mid = agg._id_for(name, samples=sum(bucket_counts.values()))
+            if mid < 0:
+                shed += sum(bucket_counts.values())
+                continue
+            for bucket, count in bucket_counts.items():
+                ids.append(mid)
+                bidx.append(bucket)
+                weights.append(count)
+        if shed:
+            with self.wheel._lock:
+                self.wheel.shed_samples += shed
+        if not ids:
+            return None
+        return (
+            np.asarray(ids, dtype=np.int32),
+            np.asarray(bidx, dtype=np.int64),
+            np.asarray(weights, dtype=np.int64),
+        )
+
+    def _dense_cells(self, cells):
+        """(ids, codec bucket, int64 weight) -> the wheel's dense int32
+        triplet, bit-for-bit the same conversion as
+        TimeWheel._cells_from_raw (clip to the dense range; clip weights
+        to the int32 wire contract)."""
+        ids, bidx64, w64 = cells
+        bl = self.wheel.config.bucket_limit
+        idx = (np.clip(bidx64, -bl, bl) + bl).astype(np.int32)
+        w32 = np.minimum(w64, np.int64(2**31 - 1)).astype(np.int32)
+        return ids, idx, w32
+
+    # -- the commit ----------------------------------------------------- #
+
+    def commit(self, raw: RawMetricSet, duration: Optional[float] = None):
+        """Land one interval on the aggregator AND every retention tier.
+        Returns the path taken ("fused", "fanout", or "empty")."""
+        t0 = time.perf_counter()
+        wheel = self.wheel
+        dur = (
+            float(duration) if duration is not None
+            else float(raw.duration) if raw.duration is not None
+            else wheel.interval
+        )
+        up0 = self._staging.uploads
+        b0 = self._staging.bytes_uploaded
+        cells = self._cells_from_raw(raw)
+        if cells is None:
+            # cell-less interval: slot rotation/durations still advance
+            # (a reopened slot's clear is the only possible dispatch)
+            wheel.push_cells(None, raw, dur)
+            mode, dispatches = "empty", 0
+        else:
+            mode, dispatches = self._commit_cells(cells, raw, dur)
+        wheel.run_hooks(raw)
+        us = (time.perf_counter() - t0) * 1e6
+        with self._metrics_lock:
+            self.intervals_committed += 1
+            if mode == "fused":
+                self.fused_intervals += 1
+            elif mode == "fanout":
+                self.fanout_intervals += 1
+            self.last_dispatches = dispatches
+            self.last_uploads = self._staging.uploads - up0
+            self.last_h2d_bytes = self._staging.bytes_uploaded - b0
+            self._latencies_us.append(us)
+        if self._ms is not None:
+            # the commit latency histogram rides the normal pipeline,
+            # so exporters/retention see it like any other metric
+            try:
+                self._ms.histogram("commit.LatencyUs", us)
+            except Exception:  # pragma: no cover - defensive
+                pass
+        return mode
+
+    def _commit_cells(self, cells, raw: RawMetricSet, dur: float):
+        """Dispatch one interval's cells.  Returns (mode, dispatches)."""
+        agg, wheel = self.aggregator, self.wheel
+        ids, bidx64, w64 = cells
+        total = int(w64.sum(dtype=np.int64))
+        with agg._dev_lock:
+            if (
+                agg._interval_ingested + total >= agg.spill_threshold
+                or int(w64.max()) >= 1 << 30
+            ):
+                # int32-overflow envelope exceeded: the aggregator side
+                # takes its exact host-spill machinery; the tiers take
+                # the fan-out scatter below (their own int32 clip
+                # contract).  Rare by construction — the guarantee wins
+                # over the dispatch count for this interval.
+                agg._merge_cells_locked(ids, bidx64, w64)
+                fused = False
+            else:
+                with wheel._lock:
+                    dispatches = self._fused_dispatch_locked(
+                        cells, raw, dur
+                    )
+                fused = True
+        if fused:
+            return "fused", dispatches
+        dense = self._dense_cells(cells)
+        wheel.push_cells(dense, raw, dur)
+        # estimate: one weighted-scatter chunk ladder for the aggregator
+        # plus one per tier (slot clears excluded)
+        nchunks = -(-len(ids) // self.chunk)
+        return "fanout", nchunks * (1 + len(wheel._tiers))
+
+    def _fused_dispatch_locked(self, cells, raw: RawMetricSet, dur: float):
+        """The fused path.  Caller holds agg._dev_lock THEN wheel._lock
+        (the committer's documented ordering).  Chunks the cells through
+        the staging ring and the single fused program; first chunk
+        carries the ring-wrap keep factors, later chunks keep
+        everything.  Returns the dispatch count."""
+        agg, wheel = self.aggregator, self.wheel
+        ids, idx, w32 = self._dense_cells(cells)
+        w64 = cells[2]
+        tiers = wheel._tiers
+        slots_host = [t.slot for t in tiers]
+        keeps_host = [
+            0 if wheel._tier_open_locked(t, s) else 1
+            for t, s in zip(tiers, slots_host)
+        ]
+        slots = np.asarray(slots_host, dtype=np.int32)
+        keeps = np.asarray(keeps_host, dtype=np.int32)
+        ones = np.ones_like(keeps)
+        wheel._note_interval_locked(raw.time, (ids, idx, w32))
+        n = len(ids)
+        dispatches = 0
+        applied = 0
+        reset_tiers = ()
+        try:
+            for off in range(0, n, self.chunk):
+                take = min(self.chunk, n - off)
+                dev_ids, dev_idx, dev_w = self._staging.stage(
+                    ids[off:off + take],
+                    idx[off:off + take],
+                    w32[off:off + take],
+                )
+                acc, rings = self._fused(
+                    agg._acc,
+                    tuple(t.ring for t in tiers),
+                    slots,
+                    keeps if dispatches == 0 else ones,
+                    dev_ids,
+                    dev_idx,
+                    dev_w,
+                )
+                agg._acc = acc
+                for t, r in zip(tiers, rings):
+                    t.ring = r
+                dispatches += 1
+                applied = off + take
+                agg._device_down_until = 0.0
+                agg._interval_ingested += int(
+                    w64[off:off + take].sum(dtype=np.int64)
+                )
+        except Exception:
+            reset_tiers = self._on_fused_failure_locked(
+                cells, applied
+            )
+        for t, s in zip(tiers, slots_host):
+            if t in reset_tiers:
+                continue  # recovery already re-zeroed its metadata
+            wheel._tier_close_locked(t, s, raw.rates, dur)
+        return dispatches
+
+    def _on_fused_failure_locked(self, cells, applied: int):
+        """Device-failure recovery for the fused path (both locks held,
+        called from inside the except handler).  The aggregator's
+        handler recovers a consumed accumulator and arms the cooldown;
+        consumed tier rings are rebuilt empty (retention history for
+        that tier resets — logged); the UNAPPLIED cell remainder folds
+        into the exact host spill, mirroring _merge_cells_locked's
+        accounting so no sample is lost or double-counted on the
+        aggregator side.  Returns the tiers whose state was reset."""
+        agg, wheel = self.aggregator, self.wheel
+        agg._on_device_failure_locked()
+        reset = []
+        for t in wheel._tiers:
+            if getattr(t.ring, "is_deleted", lambda: False)():
+                z = jnp.zeros(
+                    (t.spec.slots, wheel.num_metrics,
+                     wheel.config.num_buckets),
+                    dtype=jnp.int32,
+                )
+                t.ring = (
+                    jax.device_put(z, wheel._sharding)
+                    if wheel._sharding is not None else z
+                )
+                t.written[:] = False
+                t.durations[:] = 0.0
+                t.rates = [dict() for _ in range(t.spec.slots)]
+                t.slot = 0
+                t.in_slot = 0
+                reset.append(t)
+        if reset:
+            logger.error(
+                "fused commit failure consumed %d tier ring(s); their "
+                "retention history was reset", len(reset),
+            )
+        ids, bidx64, w64 = cells
+        if applied < len(ids):
+            agg._spill_add_cells_locked(
+                ids[applied:], bidx64[applied:], w64[applied:]
+            )
+        return tuple(reset)
+
+    # -- warmup / lifecycle --------------------------------------------- #
+
+    def warmup(self) -> None:
+        """Pre-compile the fused executable at THE commit shape (all
+        pads — numerically a no-op), same rationale as the aggregator's
+        _bridge_warmup: the first real interval must not pay the cold
+        XLA compile while the reaper fills the freshly subscribed
+        channel."""
+        agg, wheel = self.aggregator, self.wheel
+        empty = np.empty(0, dtype=np.int32)
+        with agg._dev_lock:
+            with wheel._lock:
+                tiers = wheel._tiers
+                slots = np.asarray([t.slot for t in tiers], dtype=np.int32)
+                keeps = np.ones(len(tiers), dtype=np.int32)
+                dev_ids, dev_idx, dev_w = self._staging.stage(
+                    empty, empty, empty
+                )
+                acc, rings = self._fused(
+                    agg._acc, tuple(t.ring for t in tiers),
+                    slots, keeps, dev_ids, dev_idx, dev_w,
+                )
+                agg._acc = acc
+                for t, r in zip(tiers, rings):
+                    t.ring = r
+
+    def attach(self, ms: MetricSystem, channel_capacity: int = 8) -> None:
+        """Subscribe ONCE behind the raw boundary for both consumers —
+        strike-eviction resilient, same recovery contract as the
+        journal/exporters."""
+        if self._thread is not None:
+            raise RuntimeError("already attached")
+        self.warmup()
+        self._ms = ms
+        self._sub = ResilientSubscription(
+            ms.subscribe_to_raw_metrics,
+            ms.unsubscribe_from_raw_metrics,
+            channel_capacity,
+        )
+        sub = self._sub
+
+        def bridge():
+            while True:
+                try:
+                    raw = sub.get()
+                except ChannelClosed:
+                    return
+                try:
+                    self.commit(raw)
+                except Exception:  # pragma: no cover - defensive
+                    logger.exception(
+                        "fused interval commit failed for %s", raw.time
+                    )
+
+        self._thread = threading.Thread(
+            target=bridge, daemon=True, name="loghisto-commit"
+        )
+        self._thread.start()
+
+    def detach(self) -> None:
+        if self._sub is not None:
+            self._sub.close()
+            self._sub = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- gauges ---------------------------------------------------------- #
+
+    @property
+    def bridge_evictions(self) -> int:
+        return self._sub.evictions if self._sub is not None else 0
+
+    def _latency_pct(self, q: float) -> float:
+        with self._metrics_lock:
+            if not self._latencies_us:
+                return 0.0
+            return float(np.percentile(np.asarray(self._latencies_us), q))
+
+    def register_gauges(self, ms: MetricSystem) -> None:
+        """Export the commit-path self-metrics through the normal gauge
+        pipeline: dispatches and H2D bytes per interval (the quantities
+        the fused design exists to collapse), the fused/fan-out interval
+        split, and the commit latency distribution."""
+        ms.register_gauge_func(
+            "commit.DispatchesPerInterval",
+            lambda: float(self.last_dispatches),
+        )
+        ms.register_gauge_func(
+            "commit.H2DBytesPerInterval",
+            lambda: float(self.last_h2d_bytes),
+        )
+        ms.register_gauge_func(
+            "commit.CellUploadsPerInterval",
+            lambda: float(self.last_uploads),
+        )
+        ms.register_gauge_func(
+            "commit.FusedIntervals", lambda: float(self.fused_intervals)
+        )
+        ms.register_gauge_func(
+            "commit.FanoutIntervals", lambda: float(self.fanout_intervals)
+        )
+        ms.register_gauge_func(
+            "commit.LatencyP50Us", lambda: self._latency_pct(50.0)
+        )
+        ms.register_gauge_func(
+            "commit.LatencyP99Us", lambda: self._latency_pct(99.0)
+        )
+        ms.register_gauge_func(
+            "commit.BridgeEvictions", lambda: float(self.bridge_evictions)
+        )

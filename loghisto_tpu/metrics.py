@@ -419,6 +419,8 @@ class MetricSystem:
                 self._fast_dropped_total = 0  # lifetime-cumulative
                 self._fast_counter_dropped_total = 0
                 self._fast_stop_partials: Dict[str, object] = {}
+                self._fast_rec_partials: Dict[str, object] = {}
+                self._fast_add_partials: Dict[str, object] = {}
             else:
                 logger.warning(
                     "fast_ingest requested but the extension is "
@@ -658,23 +660,43 @@ class MetricSystem:
         resolved once; record(value) is one C call + fold poll); see
         FastRecorder.  Python fallback without fast_ingest."""
         if self._fast_record is not None:
-            rec_p = functools.partial(
-                self._fastpath.record_sized, self._fast_buf,
-                self._fast_id(name),
-            )
-            return FastRecorder(name, self, rec_p)
+            return FastRecorder(name, self, self._fast_record_partial(name))
         return _PyRecorder(name, self)
 
     def counter_handle(self, name: str) -> "FastCounter | _PyCounter":
         """Reusable per-name counter handle for hot loops; see
         FastCounter.  Python fallback without fast_ingest."""
         if self._fast_record is not None:
-            add_p = functools.partial(
-                self._fastpath.record_sized,
-                self._fast_ensure_counter_buf(), self._fast_id(name),
-            )
-            return FastCounter(name, self, add_p)
+            return FastCounter(name, self, self._fast_add_partial(name))
         return _PyCounter(name, self)
+
+    def _fast_record_partial(self, name: str):
+        """Per-name functools.partial(record_sized, buf, fid) for
+        recorder(), cached with the same (buffer, partial) identity
+        check as _fast_stop_partial — repeated recorder() calls for one
+        name reuse the binding, and a test-swapped staging buffer gets a
+        rebuilt one at the next handle creation."""
+        entry = self._fast_rec_partials.get(name)
+        if entry is not None and entry[0] is self._fast_buf:
+            return entry[1]
+        p = functools.partial(
+            self._fastpath.record_sized, self._fast_buf, self._fast_id(name)
+        )
+        self._fast_rec_partials[name] = (self._fast_buf, p)
+        return p
+
+    def _fast_add_partial(self, name: str):
+        """counter_handle()'s cached binding, keyed against the COUNTER
+        staging buffer (created lazily here, like counter())."""
+        buf = self._fast_ensure_counter_buf()
+        entry = self._fast_add_partials.get(name)
+        if entry is not None and entry[0] is buf:
+            return entry[1]
+        p = functools.partial(
+            self._fastpath.record_sized, buf, self._fast_id(name)
+        )
+        self._fast_add_partials[name] = (buf, p)
+        return p
 
     def _fast_stop_partial(self, name: str):
         """Per-name functools.partial(timer_stop, buf, fid), cached —

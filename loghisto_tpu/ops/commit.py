@@ -1,0 +1,157 @@
+"""Fused interval commit: one donated-carry program for the aggregator
+fold plus every retention tier's open-slot scatter.
+
+The paper's core claim is that log-bucket histograms merge by elementwise
+addition, so every device consumer of an interval is payable with ONE
+pass over the interval's sparse bucket cells.  Before this module each
+committed interval fanned out into ~5+ separate dispatches — the
+aggregator bridge's weighted scatter (parallel/aggregator.py) plus one
+``_scatter_cells_jit`` launch per TimeWheel tier (window/store.py), each
+behind its own lock and each re-uploading the same host-built cell
+arrays.  ``make_fused_commit_fn`` collapses that to a single jitted
+program over a donated carry pytree ``(aggregator_acc, ring_0..N)``:
+
+  * the cell arrays ``(ids, idx, weights)`` are uploaded once,
+  * the aggregator fold and every tier's open-slot scatter (plus the
+    slot clear on ring wrap) execute in the same XLA program,
+  * per-tier slot indices and keep factors arrive as TRACED int32
+    operands (the jnp analog of Pallas scalar prefetch), so tier
+    rotation across intervals never recompiles — one executable serves
+    every interval for the lifetime of the shapes.
+
+``CellStagingRing`` is the async H2D front end: a depth-2
+double-buffered set of pinned host pad arrays whose ``stage()`` issues
+``jax.device_put`` and returns immediately, so interval N+1's cell
+transfer overlaps interval N's commit dispatch (the same super-chunk
+overlap design as the aggregator's raw flush path, extended to the
+bridge).  Depth 2 gives exactly one in-flight commit of slack: a slot's
+host buffers are rewritten only after the commit dispatched against the
+OTHER slot has been enqueued, which is the contract the overlap needs.
+
+The orchestration (locks, spill policy, tier metadata) lives in
+``loghisto_tpu.commit.IntervalCommitter``; this module stays pure
+jax/numpy so it is importable and testable without the runtime classes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+# Fixed commit launch width, matching the aggregator bridge's merge
+# chunk: one compiled executable serves every interval; a typical
+# interval is one launch, a 10k-metric worst case a handful.
+COMMIT_CHUNK = 1 << 16
+
+# Drop sentinel for pad (and shed) cells: far out of every row range, so
+# each scatter's mode="drop" sheds it — same design as sanitize_ids and
+# the wheel's _DROP_ID.
+DROP_ID = np.int32(2**30)
+
+
+@functools.lru_cache(maxsize=None)
+def make_fused_commit_fn(num_tiers: int):
+    """Build the fused commit program for ``num_tiers`` retention tiers.
+    Cached per tier count: the jitted program is shape-polymorphic, so
+    every committer with the same number of tiers shares one jit object
+    (and its per-shape executable cache) instead of recompiling.
+
+    Returns ``commit(acc, rings, slots, keeps, ids, idx, weights) ->
+    (acc, rings)`` where
+
+      acc     int32 [M, B]            — aggregator accumulator (donated)
+      rings   tuple of int32 [S_t, M_t, B] — tier rings (donated)
+      slots   int32 [T]               — each tier's open slot (traced,
+                                        so rotation never recompiles)
+      keeps   int32 [T]               — 0 to clear the open slot first
+                                        (ring wrap), 1 to keep it
+      ids     int32 [N]               — metric rows; DROP_ID pads/sheds
+      idx     int32 [N]               — dense bucket column in [0, B)
+      weights int32 [N]               — per-cell counts (0 on pads)
+
+    All consumers add the SAME cells: the aggregator fold is
+    ``acc[ids, idx] += weights`` and each tier's open-slot scatter is
+    ``ring[slot, ids, idx] += weights`` after multiplying the slot by
+    its keep factor (x1 = no-op, x0 = the ring-wrap clear, fused into
+    the same program instead of a separate ``_open_slot_jit`` launch).
+    Integer scatter-adds are order-independent, so the result is
+    bit-identical to the fan-out path (tests/test_commit.py pins this).
+
+    Out-of-range rows drop: the accumulator may have grown past a ring's
+    row count (registry growth), in which case those cells land in the
+    accumulator and fall off every ring — the same semantics the
+    separate paths had.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def commit(acc, rings, slots, keeps, ids, idx, weights):
+        acc = acc.at[ids, idx].add(weights, mode="drop")
+        new_rings = []
+        for t in range(num_tiers):
+            ring = rings[t]
+            ring = ring.at[slots[t]].multiply(keeps[t], mode="drop")
+            ring = ring.at[slots[t], ids, idx].add(weights, mode="drop")
+            new_rings.append(ring)
+        return acc, tuple(new_rings)
+
+    return commit
+
+
+class CellStagingRing:
+    """Depth-D double-buffered H2D staging for interval cell arrays.
+
+    Each slot owns reusable pinned host pad arrays ``(ids, idx,
+    weights)`` of the fixed commit width; ``stage()`` writes one chunk
+    into the next slot, pads the tail with drop sentinels, and issues an
+    async ``jax.device_put`` — the transfer of the NEXT chunk/interval
+    overlaps the commit dispatch of the previous one, because
+    ``device_put`` and the jitted commit both return before the device
+    work completes.
+
+    Depth 2 (the default) is the minimum that makes the overlap safe:
+    slot k's host buffers are only rewritten once a commit has been
+    dispatched against slot k^1, so the copy engine is never racing the
+    host writes of the transfer it is consuming.  Upload accounting
+    (``uploads``, ``bytes_uploaded``) feeds the committer's
+    H2D-bytes-per-interval gauge.
+    """
+
+    def __init__(self, depth: int = 2, width: int = COMMIT_CHUNK):
+        if depth < 2:
+            raise ValueError("staging ring depth must be >= 2 (the "
+                             "overlap contract needs one slot of slack)")
+        self.depth = depth
+        self.width = width
+        self._slots = [
+            (
+                np.empty(width, dtype=np.int32),
+                np.empty(width, dtype=np.int32),
+                np.empty(width, dtype=np.int32),
+            )
+            for _ in range(depth)
+        ]
+        self._next = 0
+        self.uploads = 0          # lifetime stage() calls
+        self.bytes_uploaded = 0   # lifetime H2D bytes issued
+
+    def stage(self, ids: np.ndarray, idx: np.ndarray, weights: np.ndarray):
+        """Pad one cell chunk (len <= width) into the next host slot and
+        start its async upload; returns the device arrays."""
+        n = len(ids)
+        if n > self.width:
+            raise ValueError(f"chunk of {n} cells exceeds staging width "
+                             f"{self.width}")
+        hid, hidx, hw = self._slots[self._next]
+        self._next = (self._next + 1) % self.depth
+        hid[:n] = ids
+        hid[n:] = DROP_ID
+        hidx[:n] = idx
+        hidx[n:] = 0
+        hw[:n] = weights
+        hw[n:] = 0
+        dev = jax.device_put((hid, hidx, hw))
+        self.uploads += 1
+        self.bytes_uploaded += 3 * self.width * 4
+        return dev

@@ -56,6 +56,15 @@ HIGH_CARDINALITY_KERNEL = "sort"
 # explicit selection but auto no longer picks it.
 MATMUL_MAX_CELLS = 1 << 21
 
+# Whether auto commits intervals through the fused single-dispatch
+# program (ops/commit.py: aggregator fold + all retention tiers in one
+# donated-carry launch) instead of the per-consumer fan-out.  The fused
+# program is pure XLA scatter composition — bit-identical to the fan-out
+# by construction (tests/test_commit.py) — so it defaults on; a hardware
+# capture that ever ranks the fan-out faster flips this via the same
+# committed-JSON machinery as the ingest thresholds.
+FUSED_COMMIT = True
+
 # Capture-derived threshold table (VERDICT r2 item 7): refreshing the
 # dispatch policy after a hardware capture is a committed JSON (emitted
 # by ``benchmarks/analyze_capture.py --emit-thresholds``), not a code
@@ -70,7 +79,7 @@ THRESHOLDS_SOURCE = "baked-in defaults"
 
 def _load_thresholds() -> None:
     global SORT_MIN_METRICS, PALLAS_SINGLE_METRIC, THRESHOLDS_SOURCE
-    global HIGH_CARDINALITY_KERNEL
+    global HIGH_CARDINALITY_KERNEL, FUSED_COMMIT
     try:
         with open(THRESHOLDS_FILE) as f:
             table = _json.load(f)
@@ -90,6 +99,10 @@ def _load_thresholds() -> None:
     hck = table.get("high_cardinality_kernel")
     if hck in ("sort", "sortscan"):
         HIGH_CARDINALITY_KERNEL = hck
+        applied = True
+    fc = table.get("fused_commit")
+    if isinstance(fc, bool):
+        FUSED_COMMIT = fc
         applied = True
     if applied:  # never cite a table that contributed nothing
         THRESHOLDS_SOURCE = str(table.get("source", THRESHOLDS_FILE))
@@ -184,6 +197,32 @@ def resolve_ingest_path(
             "ingest_path='pallas' is the single-metric row kernel; got "
             f"num_metrics={num_metrics} (growth past 1 row swaps kernels "
             "automatically, but the starting shape must be [1, B])"
+        )
+    return path
+
+
+def resolve_commit_path(path: str, platform: str, mesh: bool = False) -> str:
+    """Resolve the interval-commit path: "fused" (one donated-carry
+    program for the aggregator fold + every retention tier,
+    ops/commit.py) or "fanout" (the per-consumer bridge-merge +
+    per-tier-scatter launches).  "auto" follows the capture-overridable
+    FUSED_COMMIT switch — the same threshold machinery as the ingest
+    kernels, so a hardware capture retunes this with a committed JSON,
+    not a code edit.
+
+    ``mesh=True`` marks sharded state (metric-row-sharded accumulator
+    and rings): auto stays on the fan-out there — a single program over
+    differently-sharded carries has not been hardware-validated, and the
+    fan-out's per-consumer programs carry known shardings.  Explicit
+    "fused" remains available as the opt-in."""
+    if path == "auto":
+        if mesh:
+            return "fanout"
+        return "fused" if FUSED_COMMIT else "fanout"
+    if path not in ("fused", "fanout"):
+        raise ValueError(
+            f"unknown commit path {path!r}: expected 'auto', 'fused', or "
+            "'fanout'"
         )
     return path
 

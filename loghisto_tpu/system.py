@@ -48,6 +48,7 @@ class TPUMetricSystem(MetricSystem):
         native_staging: bool = False,
         fast_ingest: bool = False,
         retention=None,
+        commit: str = "auto",
     ):
         """``retention`` turns on the windowed retention tier:
         ``True`` builds a TimeWheel with the default 60x1 / 60x60 /
@@ -55,7 +56,17 @@ class TPUMetricSystem(MetricSystem):
         with those tiers, and a ready ``TimeWheel`` instance is attached
         as-is (it must share this system's registry for consistent row
         ids).  The wheel subscribes behind the same raw boundary as the
-        aggregator and shares its registry and mesh."""
+        aggregator and shares its registry and mesh.
+
+        ``commit`` picks the interval-commit pipeline when retention is
+        on: "fused" runs ONE donated-carry program per interval for the
+        aggregator fold plus every tier's open-slot scatter behind a
+        single subscription (loghisto_tpu.commit.IntervalCommitter);
+        "fanout" keeps the per-consumer bridges; "auto" (default)
+        follows the capture-overridable switch in ops/dispatch.py and
+        stays on the fan-out for sharded state.  Without retention the
+        aggregator is the only device consumer, so the fan-out IS one
+        dispatch already and ``commit`` is moot."""
         super().__init__(
             interval=interval, sys_stats=sys_stats, config=config,
             fast_ingest=fast_ingest,
@@ -67,11 +78,11 @@ class TPUMetricSystem(MetricSystem):
             mesh=mesh,
             native_staging=native_staging,
         )
-        self.aggregator.attach(self)
         self.aggregator.register_device_gauges(self)
 
         self.retention = None
         self.rule_engine = None
+        self.committer = None
         if retention is not None and retention is not False:
             from loghisto_tpu.window import (
                 DEFAULT_TIERS, RuleEngine, TimeWheel,
@@ -91,9 +102,50 @@ class TPUMetricSystem(MetricSystem):
                     registry=self.aggregator.registry,
                     mesh=mesh,
                 )
-            self.retention.attach(self)
             self.rule_engine = RuleEngine(self.retention)
             self.rule_engine.attach()
+
+        import jax
+
+        from loghisto_tpu.ops.dispatch import resolve_commit_path
+
+        platform = (
+            mesh.devices.flat[0].platform
+            if mesh is not None
+            else jax.default_backend()
+        )
+        self.commit_path = resolve_commit_path(
+            commit, platform, mesh=mesh is not None
+        )
+        if self.commit_path == "fused" and self.retention is not None:
+            from loghisto_tpu.commit import (
+                IntervalCommitter, commit_incompatibility,
+            )
+
+            reason = commit_incompatibility(self.aggregator, self.retention)
+            if reason is None:
+                # ONE subscription pays both consumers: neither the
+                # aggregator bridge nor the wheel bridge attaches
+                self.committer = IntervalCommitter(
+                    self.aggregator, self.retention
+                )
+                self.committer.attach(self)
+                self.committer.register_gauges(self)
+            elif commit == "fused":
+                # the user explicitly demanded fused; an incompatible
+                # pair must fail loudly, not silently fan out
+                raise ValueError(f"fused commit unavailable: {reason}")
+            else:
+                self.commit_path = "fanout"
+        else:
+            if self.commit_path == "fused":
+                # no retention: the aggregator is the only consumer, so
+                # the "fan-out" is already a single dispatch per interval
+                self.commit_path = "fanout"
+        if self.committer is None:
+            self.aggregator.attach(self)
+            if self.retention is not None:
+                self.retention.attach(self)
 
     def record_batch(self, ids: np.ndarray, values: np.ndarray) -> None:
         """Batched firehose ingestion straight to the device accumulator
@@ -163,16 +215,24 @@ class TPUMetricSystem(MetricSystem):
     # ------------------------------------------------------------------ #
 
     def start(self) -> None:
-        # restartable like the base class: re-attach the device bridge if a
-        # previous stop() detached it (same for the retention wheel)
-        if self.aggregator._attached is None:
-            self.aggregator.attach(self)
-        if self.retention is not None and self.retention._thread is None:
-            self.retention.attach(self)
+        # restartable like the base class: re-attach whichever commit
+        # pipeline a previous stop() detached — the fused committer is
+        # the single bridge when present, the per-consumer pair otherwise
+        if self.committer is not None:
+            if self.committer._thread is None:
+                self.committer.attach(self)
+        else:
+            if self.aggregator._attached is None:
+                self.aggregator.attach(self)
+            if self.retention is not None and self.retention._thread is None:
+                self.retention.attach(self)
         super().start()
 
     def stop(self) -> None:
-        self.aggregator.detach()
-        if self.retention is not None:
-            self.retention.detach()
+        if self.committer is not None:
+            self.committer.detach()
+        else:
+            self.aggregator.detach()
+            if self.retention is not None:
+                self.retention.detach()
         super().stop()

@@ -206,6 +206,7 @@ class TimeWheel:
             config.bucket_limit, config.precision, self.merge_path
         )
 
+        self._sharding = sharding
         self._tiers = [
             _Tier(t, num_metrics, config.num_buckets, sharding)
             for t in tiers
@@ -285,28 +286,74 @@ class TimeWheel:
             else float(raw.duration) if raw.duration is not None
             else self.interval
         )
-        cells = self._cells_from_raw(raw)
+        self.push_cells(self._cells_from_raw(raw), raw, dur)
+        self.run_hooks(raw)
+
+    def push_cells(
+        self, cells, raw: RawMetricSet, dur: float
+    ) -> None:
+        """Land pre-built interval cells (the ``_cells_from_raw``
+        triplet, or None for a cell-less interval) on every tier.  The
+        fused interval committer's fan-out fallback enters here so the
+        cell arrays are built once per interval, not once per consumer;
+        hooks are NOT run (the committer owns the interval tail — plain
+        ``push`` runs them)."""
         with self._lock:
-            self._last_time = raw.time
-            self.intervals_pushed += 1
-            if cells is not None:
-                self.samples_retained += int(cells[2].sum(dtype=np.int64))
+            self._note_interval_locked(raw.time, cells)
             for tier in self._tiers:
                 self._tier_push_locked(tier, cells, raw.rates, dur)
+
+    def run_hooks(self, raw: RawMetricSet) -> None:
+        """Fire the per-interval hooks (rule engine etc.) for ``raw`` —
+        split out so the fused committer can run them after its own
+        commit path."""
         for hook in list(self._hooks):
             try:
                 hook(raw)
             except Exception:
                 logger.exception("timewheel interval hook failed")
 
-    def _tier_push_locked(self, tier: _Tier, cells, rates, dur: float):
-        slot = tier.slot
+    def _note_interval_locked(self, time, cells) -> None:
+        """Interval-level bookkeeping shared by push_cells and the fused
+        committer (caller holds the wheel lock)."""
+        self._last_time = time
+        self.intervals_pushed += 1
+        if cells is not None:
+            self.samples_retained += int(cells[2].sum(dtype=np.int64))
+
+    def _tier_open_locked(self, tier: _Tier, slot: int) -> bool:
+        """Open ``tier``'s current slot for this interval: reset its
+        metadata when this is the slot's first interval and report
+        whether its previous ring life must be cleared (ring wrap).
+        The caller owns the actual clear — the fan-out path dispatches
+        ``_open_slot_jit``, the fused committer folds a keep-factor
+        multiply into its single program."""
+        needs_clear = False
         if tier.in_slot == 0:
-            # opening the slot: clear its previous life (ring wrap)
-            if tier.written[slot]:
-                tier.ring = _open_slot_jit(tier.ring, np.int32(slot))
+            needs_clear = bool(tier.written[slot])
             tier.durations[slot] = 0.0
             tier.rates[slot] = {}
+        return needs_clear
+
+    def _tier_close_locked(self, tier: _Tier, slot: int, rates, dur: float):
+        """Close out one interval on ``tier``: per-slot metadata fold and
+        slot rotation — shared verbatim by the fan-out scatter path and
+        the fused committer, so the two paths cannot drift."""
+        tier.written[slot] = True
+        tier.durations[slot] += dur
+        slot_rates = tier.rates[slot]
+        for name, delta in rates.items():
+            slot_rates[name] = slot_rates.get(name, 0) + delta
+        tier.in_slot += 1
+        if tier.in_slot >= tier.spec.res:
+            tier.slot = (slot + 1) % tier.spec.slots
+            tier.in_slot = 0
+
+    def _tier_push_locked(self, tier: _Tier, cells, rates, dur: float):
+        slot = tier.slot
+        if self._tier_open_locked(tier, slot):
+            # opening the slot: clear its previous life (ring wrap)
+            tier.ring = _open_slot_jit(tier.ring, np.int32(slot))
         if cells is not None:
             ids_np, idx_np, weights_np = cells
             n = len(ids_np)
@@ -321,15 +368,7 @@ class TimeWheel:
                 tier.ring = _scatter_cells_jit(
                     tier.ring, np.int32(slot), ids_pad, idx_pad, w_pad
                 )
-        tier.written[slot] = True
-        tier.durations[slot] += dur
-        slot_rates = tier.rates[slot]
-        for name, delta in rates.items():
-            slot_rates[name] = slot_rates.get(name, 0) + delta
-        tier.in_slot += 1
-        if tier.in_slot >= tier.spec.res:
-            tier.slot = (slot + 1) % tier.spec.slots
-            tier.in_slot = 0
+        self._tier_close_locked(tier, slot, rates, dur)
 
     def backfill(self, intervals: Iterable[RawMetricSet]) -> int:
         """Replay intervals (e.g. ``utils.journal.replay(path)``) into

@@ -240,3 +240,27 @@ def test_fast_ingest_folds_before_buffer_fills():
     out = ms.process_metrics(ms.collect_raw_metrics()).metrics
     assert out["h_count"] == n
     assert ms._fast_dropped_total == 0
+
+
+def test_handle_partials_cached_with_buffer_identity():
+    """recorder()/counter_handle() share one cached per-name binding
+    (like _fast_stop_partial): repeated handle creation allocates no new
+    partial, and a test-swapped staging buffer invalidates the cache so
+    new handles bind the live buffer."""
+    ms = MetricSystem(interval=3600, sys_stats=False, fast_ingest=True)
+    r1, r2 = ms.recorder("r"), ms.recorder("r")
+    assert r1._rec_p is r2._rec_p
+    c1, c2 = ms.counter_handle("c"), ms.counter_handle("c")
+    assert c1._add_p is c2._add_p
+    ms._fast_buf = ms._fastpath.create(2000)
+    r3 = ms.recorder("r")
+    assert r3._rec_p is not r1._rec_p  # rebound against the swapped buffer
+    r3.record(7.0)
+    ms._fast_counter_buf = ms._fastpath.create(2000)
+    c3 = ms.counter_handle("c")
+    assert c3._add_p is not c1._add_p
+    c3.add(3)
+    raw = ms.collect_raw_metrics()
+    assert raw.counters["c"] == 3
+    out = ms.process_metrics(raw).metrics
+    assert out["r_count"] == 1

@@ -265,11 +265,13 @@ def test_system_retention_end_to_end():
         assert raw.gauges["alert.hot"] == 1.0
     finally:
         ms.stop()
-    # stop() detached the wheel; start() re-attaches it (same contract
-    # as the aggregator bridge)
-    assert ms.retention._thread is None
+    # stop() detached the commit bridge; start() re-attaches it.  With
+    # the fused committer (the default) ONE bridge serves aggregator and
+    # wheel; on the fan-out path the wheel has its own thread.
+    bridge = ms.committer if ms.committer is not None else ms.retention
+    assert bridge._thread is None
     ms.start()
-    assert ms.retention._thread is not None
+    assert bridge._thread is not None
     ms.stop()
 
 
