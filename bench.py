@@ -402,6 +402,32 @@ def main() -> None:
         print(f"bench: host-fed stage failed: {e}", file=sys.stderr)
     ready2.set()
 
+    # windowed query-engine latencies at the 10k point (snapshot-served
+    # retention queries; benchmarks/query_engine.py has the full grid):
+    # cold = first query after a commit (one sparse gather dispatch),
+    # warm = repeat query at an unchanged epoch (host cache, zero
+    # dispatch), sparse = one-metric query reading back O(P) floats.
+    ready3 = _start_watchdog(300.0, on_timeout=lambda: print(
+        json.dumps(result), flush=True
+    ))
+    try:
+        from benchmarks.query_engine import run as query_run
+
+        q10k = query_run(reps=10)["configs"]["10000"]
+        result["query_cold_full_glob_p99_us"] = (
+            q10k["snapshot_dispatch_full_glob"]["p99_us"]
+        )
+        result["query_warm_full_glob_p99_us"] = (
+            q10k["snapshot_warm_cached_full_glob"]["p99_us"]
+        )
+        result["query_sparse_one_metric_p99_us"] = (
+            q10k["snapshot_dispatch_one_metric"]["p99_us"]
+        )
+        result["query_speedup_warm_cached"] = q10k["speedup_warm_cached"]
+    except Exception as e:  # never let the extra metric kill the bench
+        print(f"bench: query-engine stage failed: {e}", file=sys.stderr)
+    ready3.set()
+
     print(json.dumps(result))
 
 

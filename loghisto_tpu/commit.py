@@ -63,7 +63,10 @@ from loghisto_tpu.ops.commit import (
     COMMIT_CHUNK,
     CellStagingRing,
     make_fused_commit_fn,
+    make_fused_commit_snapshot_fn,
 )
+from loghisto_tpu.window.snapshot import AccSnapshot
+from loghisto_tpu.window.store import trailing_mask
 
 logger = logging.getLogger("loghisto_tpu")
 
@@ -110,6 +113,12 @@ class IntervalCommitter:
         self.wheel = wheel
         self.chunk = int(chunk)
         self._fused = make_fused_commit_fn(len(wheel._tiers))
+        # final-chunk variant: same fold + the query engine's snapshot
+        # emission (per-tier window CDFs + the acc CDF) in ONE dispatch
+        self._fused_snap = make_fused_commit_snapshot_fn(
+            len(wheel._tiers), wheel.config.bucket_limit,
+            wheel.config.precision, wheel.merge_path,
+        )
         self._staging = CellStagingRing(depth=staging_depth, width=self.chunk)
 
         # self-metrics (ISSUE 2): per-interval dispatch/H2D accounting
@@ -228,6 +237,7 @@ class IntervalCommitter:
                 # contract).  Rare by construction — the guarantee wins
                 # over the dispatch count for this interval.
                 agg._merge_cells_locked(ids, bidx64, w64)
+                agg.stats_snapshot = None  # spill path; handle is stale
                 fused = False
             else:
                 with wheel._lock:
@@ -244,12 +254,37 @@ class IntervalCommitter:
         nchunks = -(-len(ids) // self.chunk)
         return "fanout", nchunks * (1 + len(wheel._tiers))
 
+    def _post_close_masks(self, t, slot: int, dur: float, windows):
+        """Snapshot view masks for one tier as they will read AFTER this
+        interval's close-out, computed BEFORE the commit dispatches (the
+        masks ride the fused program as operands).  Simulates
+        ``_tier_close_locked``'s metadata fold on copies — written flag,
+        duration accrual, slot rotation — and runs the same
+        ``trailing_mask`` walk the live query path uses."""
+        written = t.written.copy()
+        durations = t.durations.copy()
+        written[slot] = True
+        durations[slot] += dur
+        in_slot = t.in_slot + 1
+        cur = slot
+        if in_slot >= t.spec.res:
+            cur = (slot + 1) % t.spec.slots
+            in_slot = 0
+        return np.stack([
+            trailing_mask(written, durations, cur, in_slot,
+                          t.spec.slots, w)
+            for w in windows
+        ])
+
     def _fused_dispatch_locked(self, cells, raw: RawMetricSet, dur: float):
         """The fused path.  Caller holds agg._dev_lock THEN wheel._lock
         (the committer's documented ordering).  Chunks the cells through
         the staging ring and the single fused program; first chunk
         carries the ring-wrap keep factors, later chunks keep
-        everything.  Returns the dispatch count."""
+        everything; the FINAL chunk runs the snapshot-emitting variant,
+        so the query engine's per-tier window CDFs and the aggregator's
+        acc CDF cost zero extra dispatches.  Returns the dispatch
+        count."""
         agg, wheel = self.aggregator, self.wheel
         ids, idx, w32 = self._dense_cells(cells)
         w64 = cells[2]
@@ -263,10 +298,18 @@ class IntervalCommitter:
         keeps = np.asarray(keeps_host, dtype=np.int32)
         ones = np.ones_like(keeps)
         wheel._note_interval_locked(raw.time, (ids, idx, w32))
+        emit = wheel.snapshots_enabled
+        if emit:
+            windows = wheel._view_windows_locked()
+            masks = tuple(
+                self._post_close_masks(t, s, dur, windows)
+                for t, s in zip(tiers, slots_host)
+            )
         n = len(ids)
         dispatches = 0
         applied = 0
         reset_tiers = ()
+        payloads = acc_payload = None
         try:
             for off in range(0, n, self.chunk):
                 take = min(self.chunk, n - off)
@@ -275,15 +318,28 @@ class IntervalCommitter:
                     idx[off:off + take],
                     w32[off:off + take],
                 )
-                acc, rings = self._fused(
-                    agg._acc,
-                    tuple(t.ring for t in tiers),
-                    slots,
-                    keeps if dispatches == 0 else ones,
-                    dev_ids,
-                    dev_idx,
-                    dev_w,
-                )
+                chunk_keeps = keeps if dispatches == 0 else ones
+                if emit and off + take >= n:
+                    acc, rings, payloads, acc_payload = self._fused_snap(
+                        agg._acc,
+                        tuple(t.ring for t in tiers),
+                        slots,
+                        chunk_keeps,
+                        dev_ids,
+                        dev_idx,
+                        dev_w,
+                        masks,
+                    )
+                else:
+                    acc, rings = self._fused(
+                        agg._acc,
+                        tuple(t.ring for t in tiers),
+                        slots,
+                        chunk_keeps,
+                        dev_ids,
+                        dev_idx,
+                        dev_w,
+                    )
                 agg._acc = acc
                 for t, r in zip(tiers, rings):
                     t.ring = r
@@ -294,6 +350,7 @@ class IntervalCommitter:
                     w64[off:off + take].sum(dtype=np.int64)
                 )
         except Exception:
+            payloads = acc_payload = None
             reset_tiers = self._on_fused_failure_locked(
                 cells, applied
             )
@@ -301,6 +358,20 @@ class IntervalCommitter:
             if t in reset_tiers:
                 continue  # recovery already re-zeroed its metadata
             wheel._tier_close_locked(t, s, raw.rates, dur)
+        if payloads is not None and not reset_tiers:
+            # the tier metadata now matches the simulated post-close
+            # state the masks encoded; publish the lock-free handles
+            wheel.publish_snapshot_locked(tuple(
+                wheel._tier_snapshot_locked(ti, windows, masks[ti],
+                                            payloads[ti])
+                for ti in range(len(tiers))
+            ))
+            agg.stats_snapshot = AccSnapshot(
+                epoch=wheel.intervals_pushed,
+                cdf=acc_payload["cdf"],
+                counts=acc_payload["counts"],
+                sums=acc_payload["sums"],
+            )
         return dispatches
 
     def _on_fused_failure_locked(self, cells, applied: int):
@@ -313,7 +384,11 @@ class IntervalCommitter:
         accounting so no sample is lost or double-counted on the
         aggregator side.  Returns the tiers whose state was reset."""
         agg, wheel = self.aggregator, self.wheel
-        agg._on_device_failure_locked()
+        agg._on_device_failure_locked()  # also drops agg.stats_snapshot
+        # the published wheel handle may describe rings this failure
+        # consumed; queries fall back to locked recompute until the next
+        # successful commit republishes
+        wheel.invalidate_snapshot_locked()
         reset = []
         for t in wheel._tiers:
             if getattr(t.ring, "is_deleted", lambda: False)():
@@ -369,6 +444,25 @@ class IntervalCommitter:
                 agg._acc = acc
                 for t, r in zip(tiers, rings):
                     t.ring = r
+                if wheel.snapshots_enabled:
+                    # warm the final-chunk (snapshot-emitting) variant at
+                    # the same shapes; all-False masks make the payloads
+                    # numerically empty, so nothing is published
+                    windows = wheel._view_windows_locked()
+                    masks = tuple(
+                        np.zeros((len(windows), t.spec.slots), dtype=bool)
+                        for t in tiers
+                    )
+                    dev_ids, dev_idx, dev_w = self._staging.stage(
+                        empty, empty, empty
+                    )
+                    acc, rings, _, _ = self._fused_snap(
+                        agg._acc, tuple(t.ring for t in tiers),
+                        slots, keeps, dev_ids, dev_idx, dev_w, masks,
+                    )
+                    agg._acc = acc
+                    for t, r in zip(tiers, rings):
+                        t.ring = r
 
     def attach(self, ms: MetricSystem, channel_capacity: int = 8) -> None:
         """Subscribe ONCE behind the raw boundary for both consumers —

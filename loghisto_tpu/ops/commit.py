@@ -40,6 +40,10 @@ import functools
 import jax
 import numpy as np
 
+from loghisto_tpu.config import PRECISION
+from loghisto_tpu.ops.stats import dense_cdf
+from loghisto_tpu.ops.window import window_snapshot
+
 # Fixed commit launch width, matching the aggregator bridge's merge
 # chunk: one compiled executable serves every interval; a typical
 # interval is one launch, a 10k-metric worst case a handful.
@@ -95,6 +99,53 @@ def make_fused_commit_fn(num_tiers: int):
             ring = ring.at[slots[t], ids, idx].add(weights, mode="drop")
             new_rings.append(ring)
         return acc, tuple(new_rings)
+
+    return commit
+
+
+@functools.lru_cache(maxsize=None)
+def make_fused_commit_snapshot_fn(
+    num_tiers: int,
+    bucket_limit: int,
+    precision: int = PRECISION,
+    merge_path: str = "jnp",
+):
+    """The fused commit program's FINAL-chunk variant: same donated-carry
+    fold as ``make_fused_commit_fn`` plus, in the SAME dispatch, the
+    query engine's snapshot emission — per tier, the CDF/counts/sums of
+    every materialized window view over the post-commit ring, and the
+    aggregator accumulator's own CDF payload.
+
+    Extra operand ``masks``: a tuple of bool ``[V, S_t]`` arrays, one per
+    tier — the post-interval trailing-window slot masks (full span first,
+    then pinned windows), computed host-side by simulating the slot
+    close-out BEFORE dispatch.  Masks are traced, so slot rotation never
+    recompiles; only a changed view count V (a new pinned window — rare)
+    retraces.
+
+    Returns ``(acc, rings, tier_payloads, acc_payload)`` where payload
+    dicts carry cdf/counts/sums as in ``ops.window.window_snapshot`` /
+    ``ops.stats.dense_cdf``.  The payload outputs are fresh (never
+    donated), which is what lets the store publish them as a lock-free
+    immutable handle while later commits keep donating the carries.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def commit(acc, rings, slots, keeps, ids, idx, weights, masks):
+        acc = acc.at[ids, idx].add(weights, mode="drop")
+        new_rings = []
+        payloads = []
+        for t in range(num_tiers):
+            ring = rings[t]
+            ring = ring.at[slots[t]].multiply(keeps[t], mode="drop")
+            ring = ring.at[slots[t], ids, idx].add(weights, mode="drop")
+            new_rings.append(ring)
+            payloads.append(
+                window_snapshot(ring, masks[t], bucket_limit, precision,
+                                merge_path)
+            )
+        acc_payload = dense_cdf(acc, bucket_limit, precision)
+        return acc, tuple(new_rings), tuple(payloads), acc_payload
 
     return commit
 

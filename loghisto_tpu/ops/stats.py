@@ -30,6 +30,8 @@ cumsum, which lowers as ~log2(B) whole-array passes).
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -251,3 +253,111 @@ def dense_stats(
         "sums": sums,
         "percentiles": jnp.where(nonempty, pct, 0.0),
     }
+
+
+# ---------------------------------------------------------------------- #
+# Snapshot query engine: commit-time CDF + sparse row serving
+# ---------------------------------------------------------------------- #
+#
+# dense_stats answers every metric at once, which is the right shape for
+# the interval pipeline but the wrong one for serving: a scrape or a rule
+# check re-pays the whole [M, B] scan per query.  The snapshot split
+# moves the scan to COMMIT time: ``dense_cdf`` emits the exact int32
+# bucket prefix sums (plus counts and the same f32 sums matvec) once per
+# interval, and ``snapshot_row_stats`` turns a percentile query into a
+# row gather + ``searchsorted`` over only the requested metrics.
+#
+# Selection parity: dense_stats picks "the number of buckets whose
+# integer cumsum is < k*" (two-level block search).  For a nondecreasing
+# CDF row, ``searchsorted(cdf, k*, side="left")`` returns exactly that
+# count, and the endpoint rules collapse into the same primitive —
+# first populated bucket == searchsorted(cdf, 1), last populated bucket
+# == searchsorted(cdf, total) — so a snapshot query is bit-identical to
+# dense_stats over the same histogram (tests/test_query_engine.py pins
+# this), while reading back [n, P] floats instead of [M, P].
+
+
+def dense_cdf(
+    acc: jnp.ndarray,
+    bucket_limit: int,
+    precision: int = PRECISION,
+) -> dict[str, jnp.ndarray]:
+    """Commit-time snapshot payload for a dense [M, B] count tensor:
+
+      cdf    int32 [M, B] — exact per-metric bucket prefix sums
+      counts int32 [M]    — per-metric totals (cdf[:, -1])
+      sums   f32   [M]    — the same representative matvec dense_stats
+                            uses, precomputed so a query never touches
+                            the full histogram
+    """
+    reps = bucket_representatives(bucket_limit, precision)
+    cdf = jnp.cumsum(acc, axis=1, dtype=jnp.int32)
+    return {
+        "cdf": cdf,
+        "counts": cdf[:, -1],
+        "sums": acc.astype(jnp.float32) @ reps,
+    }
+
+
+def snapshot_row_stats(
+    cdf_rows: jnp.ndarray,
+    counts: jnp.ndarray,
+    sums: jnp.ndarray,
+    ps: jnp.ndarray,
+    bucket_limit: int,
+    precision: int = PRECISION,
+) -> dict[str, jnp.ndarray]:
+    """Statistics for gathered snapshot rows: cdf_rows int32 [n, B],
+    counts int32 [n], sums f32 [n], ps f32 [P] -> counts/sums pass
+    through, percentiles [n, P].  Same k* derivation as dense_stats
+    (identical float32 operation order), then one searchsorted per row.
+    """
+    num_buckets = cdf_rows.shape[1]
+    reps = bucket_representatives(bucket_limit, precision)
+    ps = jnp.asarray(ps, dtype=jnp.float32)
+    total_f = jnp.maximum(counts, 1).astype(jnp.float32)[:, None]  # [n,1]
+    k0 = jnp.ceil(ps[None, :] * total_f)  # [n, P]
+    cands = k0[:, :, None] + jnp.arange(-1.0, 2.0)  # [n, P, 3]
+    ok = (cands / total_f[:, :, None] >= ps[None, :, None]) & (cands >= 1.0)
+    best = jnp.min(jnp.where(ok, cands, jnp.inf), axis=2)
+    k_star_f = jnp.where(jnp.isfinite(best), best, k0)
+    k_star_f = jnp.clip(k_star_f, 1.0, jnp.float32(2**31 - 256))
+    total_i = jnp.maximum(counts, 1)[:, None]
+    k_star = jnp.minimum(k_star_f.astype(jnp.int32), total_i)
+    # endpoints through the same searchsorted: rank 1 hits the first
+    # populated bucket, rank == total the last populated bucket
+    k = jnp.where(
+        ps[None, :] <= 0,
+        jnp.ones_like(k_star),
+        jnp.where(ps[None, :] >= 1, total_i, k_star),
+    )
+    pos = jax.vmap(
+        lambda row, kk: jnp.searchsorted(row, kk, side="left")
+    )(cdf_rows, k)
+    pos = jnp.minimum(pos, num_buckets - 1)
+    pct = reps[pos]
+    nonempty = (counts > 0)[:, None]
+    return {
+        "counts": counts,
+        "sums": sums,
+        "percentiles": jnp.where(nonempty, pct, 0.0),
+    }
+
+
+@functools.lru_cache(maxsize=None)
+def make_snapshot_query_fn(bucket_limit: int, precision: int = PRECISION):
+    """Jitted sparse snapshot query ``f(cdf, counts, sums, ids, ps) ->
+    stats for rows ids``: ONE gather + searchsorted dispatch, D2H
+    traffic O(len(ids) * len(ps)).  Cached per bucket geometry so every
+    wheel/aggregator with the same codec shares one jit object (and its
+    per-shape executable cache — the plan cache's backing store); ids
+    and ps are traced operands, so neither their values nor the commit
+    epoch ever retrace."""
+
+    @jax.jit
+    def query(cdf, counts, sums, ids, ps):
+        return snapshot_row_stats(
+            cdf[ids], counts[ids], sums[ids], ps, bucket_limit, precision
+        )
+
+    return query

@@ -36,7 +36,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from loghisto_tpu.config import PRECISION
 from loghisto_tpu.ops.pallas_kernels import _on_tpu
-from loghisto_tpu.ops.stats import dense_stats
+from loghisto_tpu.ops.stats import dense_cdf, dense_stats
 
 ROWS_TILE = 8  # int32 sublane tile
 
@@ -146,6 +146,56 @@ def make_window_stats_fn(
     return jax.jit(
         functools.partial(
             window_stats,
+            bucket_limit=bucket_limit,
+            precision=precision,
+            merge_path=merge_path,
+        )
+    )
+
+
+def window_snapshot(
+    ring: jnp.ndarray,
+    masks: jnp.ndarray,
+    bucket_limit: int,
+    precision: int = PRECISION,
+    merge_path: str = "jnp",
+) -> dict[str, jnp.ndarray]:
+    """Commit-time snapshot payloads for a tier: merge each masked view
+    of the ring and take its exact bucket CDF in one program.
+
+    ring: int32 [slots, M, B]; masks: bool [V, slots] — one row per
+    snapshot view (the full written span plus any pinned windows).
+    Returns cdf int32 [V, M, B], counts int32 [V, M], sums f32 [V, M].
+
+    Because cumsum is linear, the CDF of a merged window equals the sum
+    of per-slot CDFs — merging first is just the cheaper order.  The
+    per-view merge reuses the same window_merge the query path jits, so
+    snapshot contents are bit-identical to a direct recompute over the
+    identical mask (the parity contract tests/test_query_engine.py pins).
+    """
+
+    def one_view(mask):
+        if merge_path == "pallas":
+            merged = window_merge_pallas(ring, mask)
+        else:
+            merged = window_merge(ring, mask)
+        return dense_cdf(merged, bucket_limit, precision)
+
+    out = jax.vmap(one_view)(masks.astype(jnp.bool_))
+    return out
+
+
+def make_window_snapshot_fn(
+    bucket_limit: int,
+    precision: int = PRECISION,
+    merge_path: str = "jnp",
+):
+    """Jitted f(ring, masks) -> snapshot payload dict.  One executable
+    per (ring shape, view count); view counts only change when a new
+    window is pinned, so steady state never retraces."""
+    return jax.jit(
+        functools.partial(
+            window_snapshot,
             bucket_limit=bucket_limit,
             precision=precision,
             merge_path=merge_path,

@@ -440,6 +440,11 @@ class TPUAggregator:
         self._shed_lock = threading.Lock()
         self._device_down_until = 0.0
         self._interval_ingested = 0  # samples in the live accumulator
+        # immutable (epoch, cdf/counts/sums) handle over the live
+        # accumulator, published by the fused committer's snapshot
+        # dispatch; None whenever the accumulator was reset, grown,
+        # spilled, or rebuilt — readers must treat None as "recompute"
+        self.stats_snapshot = None
 
         if on_registry_full not in ("grow", "error"):
             raise ValueError(
@@ -786,6 +791,7 @@ class TPUAggregator:
         self.ingest_path = new_path
         self._acc = new_acc
         self.num_metrics = new_m
+        self.stats_snapshot = None  # row space changed; handle is stale
         self.registry.grow(new_m)
         if self._spill is not None:
             spill = np.zeros(
@@ -809,6 +815,7 @@ class TPUAggregator:
         self._acc = self._fresh_acc()
         self._spilled_samples += self._interval_ingested
         self._interval_ingested = 0
+        self.stats_snapshot = None  # acc folded out; handle is stale
 
     def record_batch(self, ids: np.ndarray, values: np.ndarray) -> None:
         """Buffer a batch of (metric_id, value) samples; flushes to device
@@ -1135,6 +1142,7 @@ class TPUAggregator:
                 self._shed_samples += self._interval_ingested
             self._interval_ingested = 0
             self._acc = self._fresh_acc()
+        self.stats_snapshot = None
 
     # -- host-tier bridge ----------------------------------------------- #
 
@@ -1362,6 +1370,7 @@ class TPUAggregator:
                 self._interval_ingested = 0
                 self._spill = None
                 self._spilled_samples = 0
+                self.stats_snapshot = None
             else:
                 acc = acc + 0  # defensive copy; donation-safe snapshot
                 spill = None if spill is None else spill.copy()

@@ -151,6 +151,14 @@ class PrometheusEndpoint:
         self._wheel = wheel
         self._windows = tuple(windows)
         self._window_quantiles = tuple(window_quantiles)
+        if wheel is not None and hasattr(wheel, "pin_window"):
+            # materialize the scrape windows as commit-time snapshot
+            # views, so a scrape serves from the latest snapshot epoch
+            # (and repeat scrapes within one interval serve the cached
+            # payload with zero device work)
+            for w in self._windows:
+                wheel.pin_window(w)
+        self._windowed_cache: Optional[tuple] = None  # (epoch, payload)
         self._sub: Optional[ResilientSubscription] = None
         self._latest: bytes = b"# no interval collected yet\n"
         self._latest_lock = threading.Lock()
@@ -161,9 +169,24 @@ class PrometheusEndpoint:
         if self._wheel is None:
             return b""
         try:
-            return windowed_exposition(
+            # serve the serialized payload straight from the latest
+            # snapshot epoch: when no interval has committed since the
+            # last scrape, the bytes are returned as-is — zero dispatch,
+            # zero reserialization.  A wheel without snapshots (or
+            # before its first commit) reports epoch None and falls
+            # through to a fresh computation every scrape, as before.
+            snap = getattr(self._wheel, "snapshot", None)
+            epoch = snap.epoch if snap is not None else None
+            cached = self._windowed_cache
+            if cached is not None and epoch is not None \
+                    and cached[0] == epoch:
+                return cached[1]
+            payload = windowed_exposition(
                 self._wheel, self._windows, self._window_quantiles
             )
+            if epoch is not None:
+                self._windowed_cache = (epoch, payload)
+            return payload
         except Exception:
             logger.exception("windowed exposition failed; serving "
                              "last-interval metrics only")

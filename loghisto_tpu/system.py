@@ -104,6 +104,9 @@ class TPUMetricSystem(MetricSystem):
                 )
             self.rule_engine = RuleEngine(self.retention)
             self.rule_engine.attach()
+            # query-engine self-metrics (commit.query_* family): snapshot
+            # age, plan-cache hits, sparse readback volume
+            self.retention.register_query_gauges(self)
 
         import jax
 
@@ -179,8 +182,10 @@ class TPUMetricSystem(MetricSystem):
         percentiles: Optional[Sequence[float]] = None,
         tier: Optional[int] = None,
     ):
-        """Sliding-window statistics over the retention wheel — one fused
-        device reduction; see TimeWheel.query."""
+        """Sliding-window statistics over the retention wheel — served
+        from the latest commit-time snapshot when one covers the window
+        (one sparse gather dispatch, or zero when the epoch hasn't
+        advanced); see TimeWheel.query."""
         return self._require_retention().query(
             pattern, window, percentiles, tier
         )

@@ -16,6 +16,12 @@ from loghisto_tpu.window.rules import (
     SloBurnRateRule,
     ThresholdRule,
 )
+from loghisto_tpu.window.snapshot import (
+    QueryPlanCache,
+    Snapshot,
+    SnapshotView,
+    TierSnapshot,
+)
 from loghisto_tpu.window.store import (
     DEFAULT_TIERS,
     TierSpec,
@@ -29,11 +35,15 @@ __all__ = [
     "DEFAULT_TIERS",
     "FIRING",
     "RESOLVED",
+    "QueryPlanCache",
     "RateOfChangeRule",
     "Rule",
     "RuleEngine",
     "SloBurnRateRule",
+    "Snapshot",
+    "SnapshotView",
     "ThresholdRule",
+    "TierSnapshot",
     "TierSpec",
     "TimeWheel",
     "WindowStats",

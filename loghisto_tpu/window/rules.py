@@ -82,6 +82,14 @@ class Rule:
     def describe(self) -> str:
         raise NotImplementedError
 
+    def device_windows(self) -> tuple:
+        """Trailing windows (seconds) this rule queries on DEVICE (via
+        ``wheel.query``) — the engine pins them so the commit path
+        materializes snapshot views and evaluation costs one sparse
+        gather instead of a full recompute.  Host-side counter rules
+        (``window_counter``) return () — nothing to pin."""
+        return ()
+
     def evaluate(self, wheel: TimeWheel, now: _dt.datetime) -> Optional[Alert]:
         """Run one evaluation step; returns a transition Alert or None."""
         value, breach = self.observe(wheel)
@@ -161,6 +169,9 @@ class ThresholdRule(Rule):
             f"{self.metric} {self.stat} over {self.window:g}s "
             f"{self.op} {self.threshold:g}"
         )
+
+    def device_windows(self) -> tuple:
+        return (self.window,)
 
 
 class RateOfChangeRule(Rule):
@@ -290,6 +301,12 @@ class RuleEngine:
             if rule.name in self._rules:
                 raise ValueError(f"rule {rule.name!r} already registered")
             self._rules[rule.name] = rule
+        # materialize the rule's query windows as snapshot views, so
+        # per-interval evaluation serves from the commit-time snapshot
+        # (a sparse gather, or the cached result) instead of a full
+        # locked recompute per rule per interval
+        for w in rule.device_windows():
+            self.wheel.pin_window(w)
         return rule
 
     def remove(self, name: str) -> None:

@@ -1,0 +1,136 @@
+"""Immutable commit-time snapshot handles for the wheel's query engine.
+
+A snapshot is the read-side half of the interval commit: every push
+(fused or fan-out) finishes by publishing one `Snapshot` — per tier, the
+exact bucket prefix sums (CDF), counts, and representative sums of each
+materialized window view, versioned by the wheel's commit epoch
+(``intervals_pushed``).  The handle is frozen and its arrays are fresh
+program outputs that are NEVER donated, so a query that has read the
+handle can run its gather+searchsorted dispatch entirely outside the
+store lock: a concurrent commit publishes a *new* handle (and may donate
+the ring buffers), but it cannot invalidate the arrays a reader already
+holds — superseded snapshots are reclaimed by ordinary GC when the last
+reader drops them.
+
+Views: each tier carries the full written span (``window_s is None``)
+plus one view per *pinned* window (Prometheus scrape windows, rule
+windows, and any window a query has previously fallen back on).  A query
+routes to the full view whenever the requested window covers the whole
+retained span, to a pinned view on exact window match, and otherwise
+falls back to the locked recompute path — auto-pinning the window so the
+next commit materializes it.
+
+`QueryPlanCache` is the host side of the plan cache: it buckets the id
+operand to the next power of two (padding with row 0; the pad rows are
+sliced off after readback) so repeated query shapes with drifting match
+counts reuse one jitted executable per (tier, n_ids-bucket, P) — jax's
+shape-keyed executable cache is the backing store, this class just
+stabilizes the shapes and counts hits/misses for the self-metrics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SnapshotView:
+    """One materialized window of one tier.  ``window_s is None`` marks
+    the full written span; ``mask``/``covered_s``/``slots`` record what
+    the view merged (the same values the locked recompute would report).
+    cdf int32 [M, B], counts int32 [M], sums f32 [M] — device arrays."""
+
+    window_s: Optional[float]
+    mask: np.ndarray
+    covered_s: float
+    slots: int
+    cdf: object
+    counts: object
+    sums: object
+
+
+@dataclasses.dataclass(frozen=True)
+class TierSnapshot:
+    """All views of one tier at one epoch."""
+
+    tier: int
+    views: Tuple[SnapshotView, ...]
+
+    def view_for(self, window_s: float) -> Optional[SnapshotView]:
+        """Route a requested window to a view: the full span when the
+        request covers everything retained (the mask walk would select
+        the same slots), else an exactly-pinned window."""
+        full = self.views[0]
+        if window_s >= full.covered_s - 1e-9:
+            return full
+        for v in self.views[1:]:
+            if v.window_s is not None and abs(v.window_s - window_s) < 1e-9:
+                return v
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class Snapshot:
+    """Epoch-versioned, immutable read handle published by the commit
+    path.  ``epoch`` == the wheel's ``intervals_pushed`` at publication;
+    a host result cache keyed on it serves repeat queries with zero
+    dispatch until the next interval lands."""
+
+    epoch: int
+    time: Optional[_dt.datetime]
+    interval: float
+    tiers: Tuple[TierSnapshot, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class AccSnapshot:
+    """The aggregator-side handle: CDF/counts/sums of the live interval
+    accumulator at one commit epoch, emitted by the same fused dispatch
+    that commits the interval.  Cleared (None) by the aggregator on any
+    accumulator reset/growth/spill — readers must treat None as
+    "recompute"."""
+
+    epoch: int
+    cdf: object
+    counts: object
+    sums: object
+
+
+class QueryPlanCache:
+    """Pow-2 id-operand padding + (tier, n_ids-bucket, P) plan-key
+    accounting.  The device-side "plan" is a jitted executable cached by
+    shape inside jax; stabilizing the shape here is what makes that
+    cache hit, and the hit/miss counters feed the commit.query_* gauge
+    family."""
+
+    def __init__(self) -> None:
+        self.hits = 0
+        self.misses = 0
+        self._seen: set = set()
+
+    @staticmethod
+    def pad_ids(ids: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Pad int32 ids up to the next power of two with row 0 (a
+        always-valid row; its extra stats are sliced off after
+        readback).  Returns (padded ids, padded length)."""
+        n = len(ids)
+        nb = 1 if n <= 1 else 1 << (n - 1).bit_length()
+        padded = np.zeros(nb, dtype=np.int32)
+        padded[:n] = ids
+        return padded, nb
+
+    def note(self, tier: int, n_bucket: int, n_ps: int) -> bool:
+        """Record one plan lookup; returns True on a hit (the padded
+        shape has been dispatched before, so the jitted executable is
+        warm)."""
+        key = (tier, n_bucket, n_ps)
+        if key in self._seen:
+            self.hits += 1
+            return True
+        self._seen.add(key)
+        self.misses += 1
+        return False

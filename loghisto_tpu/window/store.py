@@ -47,8 +47,19 @@ import numpy as np
 from loghisto_tpu.config import MetricConfig
 from loghisto_tpu.channel import ChannelClosed, ResilientSubscription
 from loghisto_tpu.metrics import MetricSystem, RawMetricSet
-from loghisto_tpu.ops.window import make_window_stats_fn, resolve_merge_path
+from loghisto_tpu.ops.stats import make_snapshot_query_fn
+from loghisto_tpu.ops.window import (
+    make_window_snapshot_fn,
+    make_window_stats_fn,
+    resolve_merge_path,
+)
 from loghisto_tpu.registry import MetricRegistry, RegistryFullError
+from loghisto_tpu.window.snapshot import (
+    QueryPlanCache,
+    Snapshot,
+    SnapshotView,
+    TierSnapshot,
+)
 
 logger = logging.getLogger("loghisto_tpu")
 
@@ -136,6 +147,39 @@ def _scatter_cells(ring, slot, ids, idx, weights):
 _scatter_cells_jit = jax.jit(_scatter_cells, donate_argnums=0)
 
 
+def trailing_mask(
+    written: np.ndarray,
+    durations: np.ndarray,
+    slot: int,
+    in_slot: int,
+    n_slots: int,
+    window_s: float,
+) -> np.ndarray:
+    """Boolean mask over ring slots covering the trailing window: walk
+    back from the open slot accumulating RECORDED slot durations until
+    the window is covered.  Duration-driven (not nominal-interval-
+    driven) so replayed history at a different cadence — e.g. a journal
+    of 0.5s intervals backfilled into a 1s wheel — still answers "the
+    trailing W seconds" correctly.
+
+    Pure function of copy-in tier state so the fused committer can
+    evaluate post-commit view masks BEFORE the commit dispatches (it
+    simulates the close-out on scalars and calls this); the wheel's own
+    ``_mask_locked`` is the same walk over live tier state."""
+    mask = np.zeros(n_slots, dtype=bool)
+    s = slot if in_slot > 0 else (slot - 1) % n_slots
+    covered = 0.0
+    for _ in range(n_slots):
+        if not written[s] or mask[s]:
+            break
+        mask[s] = True
+        covered += float(durations[s])
+        if covered >= window_s - 1e-9:
+            break
+        s = (s - 1) % n_slots
+    return mask
+
+
 class TimeWheel:
     def __init__(
         self,
@@ -147,6 +191,7 @@ class TimeWheel:
         registry: Optional[MetricRegistry] = None,
         mesh=None,
         merge_path: str = "auto",
+        snapshots: bool = True,
     ):
         """``interval`` is the base interval in seconds (one push() per
         interval); ``tiers`` resolutions are in base intervals and must
@@ -205,6 +250,26 @@ class TimeWheel:
         self._stats_fn = make_window_stats_fn(
             config.bucket_limit, config.precision, self.merge_path
         )
+        # snapshot query engine: commit-time CDF views + sparse serving.
+        # ``snapshots=False`` is the kill switch back to per-query
+        # recompute (benchmarks use it as the contender baseline).
+        self.snapshots_enabled = bool(snapshots)
+        self._snapshot_fn = make_window_snapshot_fn(
+            config.bucket_limit, config.precision, self.merge_path
+        )
+        self._query_fn = make_snapshot_query_fn(
+            config.bucket_limit, config.precision
+        )
+        self._snapshot: Optional[Snapshot] = None
+        self._pinned: List[float] = []      # pinned window seconds
+        self._max_pinned = 8
+        self._glob_cache: Dict[str, tuple] = {}   # pattern -> (gen, matches)
+        self._result_cache: Dict[tuple, tuple] = {}  # qkey -> (epoch, gen, ws)
+        self.plan_cache = QueryPlanCache()
+        self.query_snapshot_hits = 0     # queries served from a snapshot
+        self.query_fallbacks = 0         # locked-recompute fallbacks
+        self.query_result_cache_hits = 0  # zero-dispatch host-cache hits
+        self.query_rows_fetched = 0      # sparse rows read back (padded)
 
         self._sharding = sharding
         self._tiers = [
@@ -302,6 +367,7 @@ class TimeWheel:
             self._note_interval_locked(raw.time, cells)
             for tier in self._tiers:
                 self._tier_push_locked(tier, cells, raw.rates, dur)
+            self._refresh_snapshot_locked()
 
     def run_hooks(self, raw: RawMetricSet) -> None:
         """Fire the per-interval hooks (rule engine etc.) for ``raw`` —
@@ -381,6 +447,129 @@ class TimeWheel:
             n += 1
         return n
 
+    # -- snapshots ------------------------------------------------------ #
+
+    def pin_window(self, window_s: float) -> None:
+        """Ask the commit path to materialize a snapshot view for this
+        trailing window (Prometheus scrape windows, rule windows).  The
+        view appears at the NEXT interval commit; until then queries for
+        it use the locked recompute fallback.  Pins are capped (first
+        ``_max_pinned`` stick) — every uncovered window still answers
+        correctly, just without the snapshot fast path."""
+        with self._lock:
+            self._pin_window_locked(float(window_s))
+
+    def _pin_window_locked(self, w: float) -> None:
+        if w <= 0 or not math.isfinite(w):
+            return
+        if any(abs(p - w) < 1e-9 for p in self._pinned):
+            return
+        if len(self._pinned) >= self._max_pinned:
+            return
+        self._pinned.append(w)
+
+    def pinned_windows(self) -> tuple:
+        return tuple(self._pinned)
+
+    @property
+    def snapshot(self) -> Optional[Snapshot]:
+        """The latest immutable snapshot handle (or None before the
+        first commit / after a failed fused dispatch).  Reading the
+        attribute is atomic; the handle's arrays are never donated, so
+        holders may query them without the store lock."""
+        return self._snapshot
+
+    def snapshot_age_intervals(self) -> Optional[int]:
+        """Commits since the served snapshot's epoch (0 == fresh);
+        None when no snapshot exists."""
+        snap = self._snapshot
+        if snap is None:
+            return None
+        return self.intervals_pushed - snap.epoch
+
+    def _view_windows_locked(self) -> List[float]:
+        """Windows materialized per snapshot: the full written span
+        (inf sentinel) first, then the pinned windows."""
+        return [np.inf] + list(self._pinned)
+
+    def _refresh_snapshot_locked(self) -> None:
+        """Recompute every tier's snapshot views from live ring state
+        and publish a new handle (fan-out/push path; the fused committer
+        folds the same emission into its single dispatch and publishes
+        via ``publish_snapshot_locked``)."""
+        if not self.snapshots_enabled:
+            return
+        windows = self._view_windows_locked()
+        tiers = []
+        for ti, t in enumerate(self._tiers):
+            masks = np.stack([self._mask_locked(t, w) for w in windows])
+            payload = self._snapshot_fn(t.ring, masks)
+            tiers.append(self._tier_snapshot_locked(ti, windows, masks, payload))
+        self.publish_snapshot_locked(tuple(tiers))
+
+    def _tier_snapshot_locked(
+        self, ti: int, windows, masks: np.ndarray, payload
+    ) -> TierSnapshot:
+        """Wrap one tier's snapshot payload (cdf/counts/sums stacked
+        [V, ...]) into immutable views.  Caller holds the lock; tier
+        metadata must already reflect the interval the payload covers."""
+        t = self._tiers[ti]
+        views = []
+        for vi, w in enumerate(windows):
+            mask = np.asarray(masks[vi], dtype=bool)
+            views.append(SnapshotView(
+                window_s=None if not math.isfinite(w) else float(w),
+                mask=mask,
+                covered_s=float(t.durations[mask].sum()),
+                slots=int(mask.sum()),
+                cdf=payload["cdf"][vi],
+                counts=payload["counts"][vi],
+                sums=payload["sums"][vi],
+            ))
+        return TierSnapshot(tier=ti, views=tuple(views))
+
+    def publish_snapshot_locked(self, tiers: tuple) -> None:
+        """Publish a new epoch-versioned handle (caller holds the lock
+        and has already noted the interval)."""
+        self._snapshot = Snapshot(
+            epoch=self.intervals_pushed,
+            time=self._last_time,
+            interval=self.interval,
+            tiers=tiers,
+        )
+
+    def invalidate_snapshot_locked(self) -> None:
+        """Drop the published handle (fused-commit failure recovery:
+        the rings were rebuilt, the snapshot may describe lost state).
+        Queries fall back to locked recompute until the next commit."""
+        self._snapshot = None
+
+    def _resolve_glob(self, pattern: str):
+        """Glob -> ((mid, name), ...) memoized per registry generation
+        (== len(names); the registry is append-only, so an unchanged
+        generation means an unchanged match list and a grown one only
+        needs the new tail scanned).  Rows beyond the wheel's metric
+        capacity are filtered here once, not per query."""
+        names = self.registry.names()
+        gen = len(names)
+        ent = self._glob_cache.get(pattern)
+        if ent is not None and ent[0] == gen:
+            return gen, ent[1]
+        if ent is not None and ent[0] < gen:
+            matched = list(ent[1])
+            start = ent[0]
+        else:
+            matched = []
+            start = 0
+        for mid in range(start, gen):
+            if mid < self.num_metrics and fnmatch.fnmatch(names[mid], pattern):
+                matched.append((mid, names[mid]))
+        matches = tuple(matched)
+        if len(self._glob_cache) >= 256 and pattern not in self._glob_cache:
+            self._glob_cache.clear()
+        self._glob_cache[pattern] = (gen, matches)
+        return gen, matches
+
     # -- queries -------------------------------------------------------- #
 
     def _select_tier(self, needed_intervals: int) -> int:
@@ -390,26 +579,12 @@ class TimeWheel:
         return len(self._tiers) - 1
 
     def _mask_locked(self, tier: _Tier, window_s: float) -> np.ndarray:
-        """Boolean mask over ring slots covering the trailing window:
-        walk back from the open slot accumulating RECORDED slot
-        durations until the window is covered.  Duration-driven (not
-        nominal-interval-driven) so replayed history at a different
-        cadence — e.g. a journal of 0.5s intervals backfilled into a 1s
-        wheel — still answers "the trailing W seconds" correctly."""
-        mask = np.zeros(tier.spec.slots, dtype=bool)
-        slot = tier.slot if tier.in_slot > 0 else (
-            (tier.slot - 1) % tier.spec.slots
+        """Trailing-window slot mask over live tier state (see
+        ``trailing_mask`` for the walk semantics)."""
+        return trailing_mask(
+            tier.written, tier.durations, tier.slot, tier.in_slot,
+            tier.spec.slots, window_s,
         )
-        covered = 0.0
-        for _ in range(tier.spec.slots):
-            if not tier.written[slot] or mask[slot]:
-                break
-            mask[slot] = True
-            covered += float(tier.durations[slot])
-            if covered >= window_s - 1e-9:
-                break
-            slot = (slot - 1) % tier.spec.slots
-        return mask
 
     def query(
         self,
@@ -421,11 +596,16 @@ class TimeWheel:
         """Sliding-window statistics for every metric matching the glob
         ``pattern`` over the trailing ``window`` seconds.
 
-        Picks the finest tier covering the window (override with
-        ``tier``), merges the covered ring slots in one fused device
-        reduction, and extracts counts/sums/percentiles for all rows in
-        the same program.  The open (partial) slot is included, so the
-        window's trailing edge is live."""
+        Served from the latest commit-time snapshot when one covers the
+        window (the full written span, or an exactly pinned window):
+        cached glob resolution, ONE jitted gather+searchsorted dispatch
+        over only the matched rows, sparse ``[n, P]`` readback — all
+        without the store lock (the handle's arrays are never donated).
+        Repeat queries at an unchanged epoch return the host-cached
+        result with zero dispatch.  Windows no snapshot view covers fall
+        back to the locked full recompute and auto-pin themselves so the
+        next commit materializes them.  The open (partial) slot is
+        included either way, so the window's trailing edge is live."""
         ps = tuple(
             float(p) for p in (
                 percentiles if percentiles is not None else self.percentiles
@@ -435,18 +615,95 @@ class TimeWheel:
             raise ValueError("percentiles must be in [0, 1]")
         if window is None:
             window = self._tiers[-1].span_intervals() * self.interval
+        window = float(window)
         needed = max(1, math.ceil(window / self.interval))
         ti = self._select_tier(needed) if tier is None else int(tier)
         if not 0 <= ti < len(self._tiers):
             raise ValueError(f"tier {ti} out of range")
+
+        snap = self._snapshot  # atomic ref read; handle is immutable
+        view = None
+        if self.snapshots_enabled and snap is not None:
+            view = snap.tiers[ti].view_for(window)
+        if view is None:
+            if self.snapshots_enabled:
+                self.pin_window(window)
+            self.query_fallbacks += 1
+            return self._query_recompute(pattern, window, ps, ti)
+        return self._query_snapshot(pattern, window, ps, ti, snap, view)
+
+    def _query_snapshot(
+        self, pattern: str, window: float, ps: tuple, ti: int,
+        snap: Snapshot, view: SnapshotView,
+    ) -> WindowStats:
+        """Lock-free snapshot serve: resolve the glob (cached), check
+        the host result cache for this epoch, else run one sparse
+        gather+searchsorted dispatch over the matched rows."""
+        self.query_snapshot_hits += 1
+        gen, matches = self._resolve_glob(pattern)
+        qkey = (pattern, window, ps, ti)
+        cached = self._result_cache.get(qkey)
+        if (
+            cached is not None
+            and cached[0] == snap.epoch and cached[1] == gen
+        ):
+            self.query_result_cache_hits += 1
+            return cached[2]
+        keys = [pct_key(p) for p in ps]
+        metrics: Dict[str, Dict[str, float]] = {}
+        if matches:
+            ids_np = np.fromiter(
+                (mid for mid, _ in matches), dtype=np.int32,
+                count=len(matches),
+            )
+            padded, nb = QueryPlanCache.pad_ids(ids_np)
+            self.plan_cache.note(ti, nb, len(ps))
+            out = self._query_fn(
+                view.cdf, view.counts, view.sums, padded,
+                np.asarray(ps, dtype=np.float32),
+            )
+            self.query_rows_fetched += nb
+            counts = np.asarray(out["counts"])
+            sums = np.asarray(out["sums"])
+            pcts = np.asarray(out["percentiles"])
+            for i, (mid, name) in enumerate(matches):
+                count = int(counts[i])
+                if count == 0:
+                    continue
+                entry = {
+                    "count": float(count),
+                    "sum": float(sums[i]),
+                    "avg": float(sums[i]) / count,
+                }
+                for key, value in zip(keys, pcts[i]):
+                    entry[key] = float(value)
+                metrics[name] = entry
+        ws = WindowStats(
+            time=snap.time or _dt.datetime.now(tz=_dt.timezone.utc),
+            window_s=window,
+            covered_s=view.covered_s,
+            tier=ti,
+            slots=view.slots,
+            metrics=metrics,
+        )
+        if len(self._result_cache) >= 128 and qkey not in self._result_cache:
+            self._result_cache.clear()
+        self._result_cache[qkey] = (snap.epoch, gen, ws)
+        return ws
+
+    def _query_recompute(
+        self, pattern: str, window: float, ps: tuple, ti: int
+    ) -> WindowStats:
+        """Locked full recompute — the pre-snapshot path, kept for
+        windows without a materialized view (and as the parity oracle in
+        tests).  The device call stays under the lock: a concurrent push
+        would otherwise donate the ring buffer out from under it."""
         t = self._tiers[ti]
         ps_arr = np.asarray(ps, dtype=np.float32)
         with self._lock:
-            mask = self._mask_locked(t, float(window))
+            mask = self._mask_locked(t, window)
             covered = float(t.durations[mask].sum())
             ts = self._last_time or _dt.datetime.now(tz=_dt.timezone.utc)
-            # the device call stays under the lock: a concurrent push
-            # would otherwise donate the ring buffer out from under it
             stats = self._stats_fn(t.ring, mask, ps_arr)
             counts = np.asarray(stats["counts"])
             sums = np.asarray(stats["sums"])
@@ -470,7 +727,7 @@ class TimeWheel:
             metrics[name] = entry
         return WindowStats(
             time=ts,
-            window_s=float(window),
+            window_s=window,
             covered_s=covered,
             tier=ti,
             slots=int(mask.sum()),
@@ -502,6 +759,42 @@ class TimeWheel:
         wheel has no covered history yet."""
         total, covered = self.window_counter(name, window)
         return total / covered if covered > 0 else 0.0
+
+    def register_query_gauges(self, ms: MetricSystem) -> None:
+        """Export the query engine's self-metrics through the normal
+        gauge pipeline, alongside the committer's ``commit.*`` family:
+        snapshot age (intervals behind; -1 before the first snapshot),
+        plan-cache hits/misses, sparse rows fetched, and the
+        snapshot-vs-fallback serve split."""
+        def age() -> float:
+            a = self.snapshot_age_intervals()
+            return -1.0 if a is None else float(a)
+
+        ms.register_gauge_func("commit.query_SnapshotAgeIntervals", age)
+        ms.register_gauge_func(
+            "commit.query_PlanCacheHits",
+            lambda: float(self.plan_cache.hits),
+        )
+        ms.register_gauge_func(
+            "commit.query_PlanCacheMisses",
+            lambda: float(self.plan_cache.misses),
+        )
+        ms.register_gauge_func(
+            "commit.query_SparseRowsFetched",
+            lambda: float(self.query_rows_fetched),
+        )
+        ms.register_gauge_func(
+            "commit.query_SnapshotServed",
+            lambda: float(self.query_snapshot_hits),
+        )
+        ms.register_gauge_func(
+            "commit.query_RecomputeFallbacks",
+            lambda: float(self.query_fallbacks),
+        )
+        ms.register_gauge_func(
+            "commit.query_ResultCacheHits",
+            lambda: float(self.query_result_cache_hits),
+        )
 
     # -- subscription bridge ------------------------------------------- #
 

@@ -160,14 +160,20 @@ def test_one_dispatch_one_upload_per_interval_with_three_tiers():
     committer = IntervalCommitter(agg, wheel)  # default COMMIT_CHUNK
     committer.warmup()
 
-    calls = {"fused": 0, "wheel_jit": 0}
+    calls = {"fused": 0, "snap": 0, "wheel_jit": 0}
     real_fused = committer._fused
+    real_snap = committer._fused_snap
 
     def counting_fused(*a, **kw):
         calls["fused"] += 1
         return real_fused(*a, **kw)
 
+    def counting_snap(*a, **kw):
+        calls["snap"] += 1
+        return real_snap(*a, **kw)
+
     committer._fused = counting_fused
+    committer._fused_snap = counting_snap
     from loghisto_tpu.window import store as store_mod
 
     real_scatter = store_mod._scatter_cells_jit
@@ -189,13 +195,17 @@ def test_one_dispatch_one_upload_per_interval_with_three_tiers():
             up0 = committer._staging.uploads
             mode = committer.commit(_raw(i, hists))
             assert mode == "fused"
-            assert calls["fused"] <= 2, "interval exceeded 2 dispatches"
+            dispatches = calls["fused"] + calls["snap"]
+            assert dispatches <= 2, "interval exceeded 2 dispatches"
+            # the final chunk always routes through the snapshot-emitting
+            # variant: percentile queries are prepaid by the same program
+            assert calls["snap"] == 1
             assert committer._staging.uploads - up0 == 1, (
                 "interval uploaded cells more than once"
             )
             assert committer.last_dispatches <= 2
             assert committer.last_uploads == 1
-            calls["fused"] = 0
+            calls["fused"] = calls["snap"] = 0
         # the wheel's per-tier fan-out jits never ran: the fused program
         # paid every tier (and the aggregator) itself
         assert calls["wheel_jit"] == 0

@@ -38,6 +38,22 @@ def test_slo_alerts_example_runs():
     assert 'api_latency_w1m{quantile="0.99"}' in out
 
 
+def test_percentile_queries_example_runs():
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "percentile_queries.py")],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    assert "backfilled 60 intervals" in out
+    assert "age 0 intervals" in out
+    # the single-metric tail query reads back ONE row, not all 64
+    assert "rows read back: 1 (of 64" in out
+    assert "repeat query cached: 1 hit, 0 dispatches" in out
+    assert "recompute fallbacks 0" in out
+
+
 def test_migrate_from_go_example_runs():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", "migrate_from_go.py")],

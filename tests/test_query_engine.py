@@ -1,0 +1,367 @@
+"""Snapshot query engine (the PR-3 tentpole): commit-time CDF caching,
+sparse gather readback, lock-free percentile serving.  Pins bit-parity
+against the locked recompute oracle (open-slot liveness, ring rotation
+across epochs), the <= 1 interval staleness contract, the zero-dispatch
+result cache, glob/plan cache behavior, failure invalidation, the
+aggregator-side AccSnapshot, and the commit-vs-query thread race."""
+
+import datetime as dt
+import threading
+
+import numpy as np
+import pytest
+
+from loghisto_tpu.commit import IntervalCommitter
+from loghisto_tpu.config import MetricConfig
+from loghisto_tpu.metrics import RawMetricSet
+from loghisto_tpu.parallel.aggregator import TPUAggregator
+from loghisto_tpu.window import TierSpec, TimeWheel
+from loghisto_tpu.window.snapshot import QueryPlanCache
+
+pytestmark = pytest.mark.query
+
+T0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+
+
+def _raw(i, histograms=None, rates=None, duration=1.0):
+    return RawMetricSet(
+        time=T0 + dt.timedelta(seconds=i), counters={},
+        rates=dict(rates or {}), histograms=dict(histograms or {}),
+        gauges={}, duration=duration,
+    )
+
+
+def _hists(rng, names, bucket_limit, cells=12):
+    out = {}
+    for name in names:
+        b = rng.integers(-bucket_limit, bucket_limit, cells)
+        c = rng.integers(1, 50, cells)
+        h = {}
+        for bb, cc in zip(b, c):
+            h[int(bb)] = h.get(int(bb), 0) + int(cc)
+        out[name] = h
+    return out
+
+
+def _pair(num_metrics=8, bucket_limit=64, tiers=((8, 1), (4, 4))):
+    cfg = MetricConfig(bucket_limit=bucket_limit)
+    agg = TPUAggregator(num_metrics=num_metrics, config=cfg)
+    wheel = TimeWheel(num_metrics=num_metrics, config=cfg, interval=1.0,
+                      tiers=tiers, registry=agg.registry)
+    committer = IntervalCommitter(agg, wheel)
+    committer.warmup()
+    return committer, agg, wheel
+
+
+def _assert_query_parity(wheel, pattern, window, ps):
+    """The snapshot serve must be BIT-identical to the locked recompute
+    oracle — both run the same jitted merge/percentile arithmetic, the
+    snapshot merely prepays the CDF at commit time."""
+    got = wheel.query(pattern, window=window, percentiles=ps)
+    ti = got.tier
+    ref = wheel._query_recompute(pattern, float(window), tuple(ps), ti)
+    assert got.metrics == ref.metrics  # exact float equality, not approx
+    assert got.covered_s == ref.covered_s
+    assert got.slots == ref.slots
+    return got
+
+
+# ---------------------------------------------------------------------- #
+# parity: snapshot serve == locked recompute, bit for bit
+# ---------------------------------------------------------------------- #
+
+def test_snapshot_query_bit_identical_to_recompute():
+    committer, agg, wheel = _pair()
+    rng = np.random.default_rng(0)
+    names = [f"m{j}" for j in range(6)]
+    for i in range(5):
+        committer.commit(_raw(i, _hists(rng, names, 64)))
+    assert wheel.snapshot is not None
+    hits0 = wheel.query_snapshot_hits
+    _assert_query_parity(wheel, "*", 32.0, (0.0, 0.5, 0.9, 0.99, 1.0))
+    _assert_query_parity(wheel, "m[0-2]", 32.0, (0.5, 0.999))
+    assert wheel.query_snapshot_hits > hits0
+    assert wheel.query_fallbacks == 0
+
+
+def test_open_slot_liveness_in_snapshot():
+    """The coarse tier's open (partial) slot is inside the snapshot: the
+    window's trailing edge is live, not one-rotation stale."""
+    committer, agg, wheel = _pair(tiers=((8, 1), (4, 4)))
+    rng = np.random.default_rng(1)
+    committer.commit(_raw(0, _hists(rng, ["m"], 64)))  # coarse slot 1/4 full
+    got = _assert_query_parity(wheel, "m", 16.0, (0.5,))
+    assert got.tier == 1 and got.metrics["m"]["count"] > 0
+    total = sum(_hists(np.random.default_rng(1), ["m"], 64)["m"].values())
+    assert got.metrics["m"]["count"] == total
+
+
+def test_parity_across_ring_rotation_epochs():
+    """Every epoch across a full ring wrap (slots re-opened, oldest
+    evicted) stays bit-identical to the recompute on both tiers."""
+    committer, agg, wheel = _pair(num_metrics=4,
+                                  tiers=((4, 1), (2, 2)))
+    rng = np.random.default_rng(2)
+    for i in range(9):  # > 2 full wraps of the fine tier
+        committer.commit(_raw(i, _hists(rng, ["a", "b"], 64)))
+        assert wheel.snapshot.epoch == wheel.intervals_pushed
+        _assert_query_parity(wheel, "*", 4.0, (0.5, 0.99))
+        _assert_query_parity(wheel, "*", 1e9, (0.5,))  # coarsest, full span
+    assert wheel.query_fallbacks == 0
+    assert committer.fanout_intervals == 0
+
+
+def test_snapshot_staleness_at_most_one_interval():
+    """Every commit — including cell-less intervals, which still rotate
+    slots — republishes; a query never reads data older than the last
+    committed interval."""
+    committer, agg, wheel = _pair()
+    rng = np.random.default_rng(3)
+    for i in range(4):
+        committer.commit(_raw(i, _hists(rng, ["m"], 64)))
+        assert wheel.snapshot_age_intervals() == 0
+    committer.commit(_raw(4))  # empty interval: rotation only
+    assert wheel.snapshot_age_intervals() == 0
+    assert wheel.snapshot.epoch == wheel.intervals_pushed
+
+
+# ---------------------------------------------------------------------- #
+# window pinning: uncovered windows fall back once, then materialize
+# ---------------------------------------------------------------------- #
+
+def test_uncovered_window_falls_back_then_materializes():
+    committer, agg, wheel = _pair(tiers=((8, 1),))
+    rng = np.random.default_rng(4)
+    for i in range(4):
+        committer.commit(_raw(i, _hists(rng, ["m"], 64)))
+    # 2s < the 4s covered span: no snapshot view covers it -> locked
+    # recompute + auto-pin
+    f0 = wheel.query_fallbacks
+    first = wheel.query("m", window=2.0, percentiles=(0.5,))
+    assert wheel.query_fallbacks == f0 + 1
+    assert 2.0 in wheel.pinned_windows()
+    # the next commit materializes the pinned view; served lock-free now
+    committer.commit(_raw(4, _hists(rng, ["m"], 64)))
+    h0 = wheel.query_snapshot_hits
+    _assert_query_parity(wheel, "m", 2.0, (0.5,))
+    assert wheel.query_snapshot_hits == h0 + 1
+    assert first.metrics["m"]["count"] > 0
+
+
+# ---------------------------------------------------------------------- #
+# caches: glob resolution, plan shapes, host results
+# ---------------------------------------------------------------------- #
+
+def test_glob_cache_reused_and_extended_incrementally():
+    committer, agg, wheel = _pair(num_metrics=8)
+    rng = np.random.default_rng(5)
+    committer.commit(_raw(0, _hists(rng, ["a0", "a1", "b0"], 64)))
+    gen1, matches1 = wheel._resolve_glob("a*")
+    gen1b, matches1b = wheel._resolve_glob("a*")
+    assert gen1b == gen1 and matches1b is matches1  # cached, same object
+    assert [n for _, n in matches1] == ["a0", "a1"]
+    # registering a new matching metric bumps the generation; the cache
+    # extends over only the new ids (append-only registry)
+    committer.commit(_raw(1, _hists(rng, ["a2"], 64)))
+    gen2, matches2 = wheel._resolve_glob("a*")
+    assert gen2 > gen1
+    assert [n for _, n in matches2] == ["a0", "a1", "a2"]
+
+
+def test_plan_cache_pow2_padding():
+    assert QueryPlanCache.pad_ids(np.asarray([7], np.int32))[1] == 1
+    for n, nb in ((2, 2), (3, 4), (5, 8), (9, 16)):
+        padded, got = QueryPlanCache.pad_ids(
+            np.arange(n, dtype=np.int32))
+        assert got == nb and len(padded) == nb
+        assert (padded[n:] == 0).all()  # pad rows sliced off post-gather
+
+    committer, agg, wheel = _pair(num_metrics=8)
+    rng = np.random.default_rng(6)
+    committer.commit(_raw(0, _hists(rng, ["a0", "a1", "a2", "b0"], 64)))
+    m0 = wheel.plan_cache.misses
+    wheel.query("a*", window=1e9, percentiles=(0.5,))  # 3 ids -> pad 4
+    assert wheel.plan_cache.misses == m0 + 1
+    h0 = wheel.plan_cache.hits
+    # distinct glob, same (tier, pad bucket, P) -> same plan, a hit
+    wheel.query("[ab]*", window=1e9, percentiles=(0.5,))
+    assert wheel.plan_cache.hits == h0 + 1 and wheel.plan_cache.misses == m0 + 1
+
+
+def test_result_cache_zero_dispatch_until_epoch_advances():
+    committer, agg, wheel = _pair()
+    rng = np.random.default_rng(7)
+    committer.commit(_raw(0, _hists(rng, ["m"], 64)))
+    calls = []
+    inner = wheel._query_fn
+    wheel._query_fn = lambda *a: (calls.append(1), inner(*a))[1]
+    r1 = wheel.query("m", window=1e9, percentiles=(0.9,))
+    r2 = wheel.query("m", window=1e9, percentiles=(0.9,))
+    assert len(calls) == 1 and r2 is r1  # second serve: host cache only
+    committer.commit(_raw(1, _hists(rng, ["m"], 64)))  # epoch advances
+    r3 = wheel.query("m", window=1e9, percentiles=(0.9,))
+    assert len(calls) == 2 and r3 is not r1
+
+
+def test_sparse_readback_is_rows_requested_not_all_metrics():
+    committer, agg, wheel = _pair(num_metrics=64)
+    rng = np.random.default_rng(8)
+    names = [f"m{j}" for j in range(40)]
+    committer.commit(_raw(0, _hists(rng, names, 64)))
+    rows0 = wheel.query_rows_fetched
+    wheel.query("m7", window=1e9, percentiles=(0.99,))
+    assert wheel.query_rows_fetched - rows0 == 1  # O(P), not O(M*P)
+    rows1 = wheel.query_rows_fetched
+    wheel.query("m1?", window=1e9, percentiles=(0.99,))  # m10..m19 -> pad 16
+    assert wheel.query_rows_fetched - rows1 == 16
+
+
+# ---------------------------------------------------------------------- #
+# invalidation: failures and spills can never serve a stale handle
+# ---------------------------------------------------------------------- #
+
+def test_fused_failure_invalidates_snapshot_and_falls_back():
+    committer, agg, wheel = _pair()
+    rng = np.random.default_rng(9)
+    committer.commit(_raw(0, _hists(rng, ["m"], 64)))
+    assert wheel.snapshot is not None and agg.stats_snapshot is not None
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected device failure")
+
+    committer._fused = committer._fused_snap = boom
+    committer.commit(_raw(1, _hists(rng, ["m"], 64)))
+    assert wheel.snapshot is None          # handle dropped, not served stale
+    assert agg.stats_snapshot is None
+    f0 = wheel.query_fallbacks
+    res = wheel.query("m", window=1e9, percentiles=(0.5,))
+    assert wheel.query_fallbacks == f0 + 1  # locked recompute still works
+    assert res.metrics["m"]["count"] > 0
+
+
+def test_spill_interval_drops_acc_snapshot():
+    committer, agg, wheel = _pair()
+    rng = np.random.default_rng(10)
+    committer.commit(_raw(0, _hists(rng, ["m"], 64)))
+    assert agg.stats_snapshot is not None
+    agg.spill_threshold = 10  # force the exact host-spill envelope
+    committer.commit(_raw(1, _hists(rng, ["m"], 64)))
+    assert committer.fanout_intervals == 1
+    assert agg.stats_snapshot is None
+    # the wheel side took the fan-out scatter, which still republishes
+    assert wheel.snapshot_age_intervals() == 0
+
+
+def test_acc_snapshot_matches_accumulator():
+    committer, agg, wheel = _pair()
+    rng = np.random.default_rng(11)
+    for i in range(3):
+        committer.commit(_raw(i, _hists(rng, ["m", "n"], 64)))
+    snap = agg.stats_snapshot
+    assert snap.epoch == wheel.intervals_pushed
+    acc = np.asarray(agg._acc)
+    cdf = np.asarray(snap.cdf)
+    np.testing.assert_array_equal(cdf, np.cumsum(acc, axis=1))
+    np.testing.assert_array_equal(np.asarray(snap.counts), cdf[:, -1])
+    assert np.isfinite(np.asarray(snap.sums)).all()
+    # collect(reset=True) zeroes the accumulator: the handle must go too
+    agg.collect(reset=True)
+    assert agg.stats_snapshot is None
+
+
+# ---------------------------------------------------------------------- #
+# concurrency: queries never block commits, commits never tear queries
+# ---------------------------------------------------------------------- #
+
+def test_threaded_commit_vs_query_race():
+    committer, agg, wheel = _pair(num_metrics=8)
+    rng = np.random.default_rng(12)
+    names = [f"m{j}" for j in range(4)]
+    committer.commit(_raw(0, _hists(rng, names, 64)))
+    errors = []
+    stop = threading.Event()
+
+    def committing():
+        try:
+            for i in range(1, 40):
+                committer.commit(_raw(i, _hists(rng, names, 64)))
+        except Exception as e:  # pragma: no cover - the assertion target
+            errors.append(e)
+        finally:
+            stop.set()
+
+    results = []
+
+    def querying():
+        try:
+            while not stop.is_set():
+                res = wheel.query("*", window=1e9,
+                                  percentiles=(0.5, 0.99))
+                for entry in res.metrics.values():
+                    assert entry["count"] > 0
+                results.append(res)
+        except Exception as e:  # pragma: no cover - the assertion target
+            errors.append(e)
+
+    threads = [threading.Thread(target=committing)] + [
+        threading.Thread(target=querying) for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors
+    assert results and wheel.query_snapshot_hits > 0
+    assert committer.fanout_intervals == 0
+    # quiescent parity: the last published epoch serves bit-identically
+    _assert_query_parity(wheel, "*", 1e9, (0.5, 0.99))
+
+
+def test_query_holds_no_store_lock_while_serving():
+    """The lock-free contract itself: a snapshot-served query completes
+    while another thread HOLDS the store lock (pre-change, the query
+    would deadlock here — satellite 1's query-blocks-commit stall)."""
+    committer, agg, wheel = _pair()
+    rng = np.random.default_rng(13)
+    committer.commit(_raw(0, _hists(rng, ["m"], 64)))
+    wheel.query("m", window=1e9, percentiles=(0.5,))  # warm plan + glob
+    wheel._result_cache.clear()  # force the gather dispatch, not the cache
+    done = threading.Event()
+
+    def locked_query():
+        with wheel._lock:  # a commit mid-flight, from the query's view
+            t = threading.Thread(
+                target=lambda: (
+                    wheel.query("m", window=1e9, percentiles=(0.5,)),
+                    done.set(),
+                )
+            )
+            t.start()
+            t.join(timeout=30)
+
+    locked_query()
+    assert done.is_set(), "query blocked on the store lock"
+
+
+# ---------------------------------------------------------------------- #
+# exposition: the endpoint serves from the snapshot epoch
+# ---------------------------------------------------------------------- #
+
+def test_prometheus_windowed_payload_cached_per_epoch():
+    from loghisto_tpu.prometheus import PrometheusEndpoint
+    from loghisto_tpu.metrics import MetricSystem
+
+    committer, agg, wheel = _pair(tiers=((8, 1),))
+    ep = PrometheusEndpoint(MetricSystem(interval=3600.0), wheel=wheel,
+                            windows=(4.0,))
+    assert 4.0 in wheel.pinned_windows()  # scrape windows pre-pinned
+    rng = np.random.default_rng(14)
+    committer.commit(_raw(0, _hists(rng, ["m"], 64)))
+    p1 = ep._windowed_payload()
+    h0 = wheel.query_snapshot_hits
+    p2 = ep._windowed_payload()
+    assert p2 is p1  # same epoch: the serialized bytes, zero work
+    assert wheel.query_snapshot_hits == h0
+    committer.commit(_raw(1, _hists(rng, ["m"], 64)))
+    p3 = ep._windowed_payload()
+    assert p3 is not p1 and b"m_w4s" in p3

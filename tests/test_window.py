@@ -85,16 +85,33 @@ def test_sixty_interval_window_matches_reaggregation():
 
 
 def test_query_cost_is_one_device_program():
-    """The fused stats function is called once per query (no
-    per-interval device loop)."""
+    """Query dispatch accounting, both engines: with snapshots the query
+    never touches the full-recompute stats program (one sparse gather on
+    the first query, ZERO dispatches on a repeat at the same epoch);
+    with snapshots off, the recompute is one fused stats call — no
+    per-interval device loop either way."""
     cfg = MetricConfig(bucket_limit=256)
     wheel = TimeWheel(num_metrics=4, config=cfg, tiers=[TierSpec(16, 1)])
     for i in range(16):
         wheel.push(_raw(i, {"m": [float(i + 1)] * 10}))
-    calls = []
-    inner = wheel._stats_fn
-    wheel._stats_fn = lambda *a: (calls.append(1), inner(*a))[1]
+    stats_calls, gather_calls = [], []
+    inner_stats = wheel._stats_fn
+    inner_gather = wheel._query_fn
+    wheel._stats_fn = lambda *a: (stats_calls.append(1), inner_stats(*a))[1]
+    wheel._query_fn = lambda *a: (gather_calls.append(1), inner_gather(*a))[1]
     wheel.query("m", window=16.0)
+    assert len(stats_calls) == 0 and len(gather_calls) == 1
+    wheel.query("m", window=16.0)  # same epoch: host result cache
+    assert len(stats_calls) == 0 and len(gather_calls) == 1
+
+    plain = TimeWheel(num_metrics=4, config=cfg, tiers=[TierSpec(16, 1)],
+                      snapshots=False)
+    for i in range(16):
+        plain.push(_raw(i, {"m": [float(i + 1)] * 10}))
+    calls = []
+    inner = plain._stats_fn
+    plain._stats_fn = lambda *a: (calls.append(1), inner(*a))[1]
+    plain.query("m", window=16.0)
     assert len(calls) == 1
 
 
