@@ -428,6 +428,29 @@ def main() -> None:
         print(f"bench: query-engine stage failed: {e}", file=sys.stderr)
     ready3.set()
 
+    # lifecycle-under-churn headline (benchmarks/cardinality_churn.py has
+    # the 1k/16k/100k grid): commit p99 while evicting/compacting, the
+    # bounded-rows claim, and the repack cost at the 16k point.
+    ready4 = _start_watchdog(300.0, on_timeout=lambda: print(
+        json.dumps(result), flush=True
+    ))
+    try:
+        from benchmarks.cardinality_churn import run as churn_run
+
+        c16k = churn_run(configs=["16000"])["configs"]["16000"]
+        result["churn_commit_p99_us"] = c16k["commit_latency"]["p99_us"]
+        result["churn_bounded_by_live_budget"] = (
+            c16k["bounded_by_live_budget"]
+        )
+        result["churn_evicted_series"] = c16k["evicted_series"]
+        result["churn_compaction_p99_us"] = (
+            c16k["compaction_latency"]["p99_us"]
+            if c16k["compaction_latency"] else None
+        )
+    except Exception as e:  # never let the extra metric kill the bench
+        print(f"bench: cardinality-churn stage failed: {e}", file=sys.stderr)
+    ready4.set()
+
     print(json.dumps(result))
 
 

@@ -105,6 +105,7 @@ class IntervalCommitter:
         wheel,
         chunk: int = COMMIT_CHUNK,
         staging_depth: int = 2,
+        lifecycle=None,
     ):
         reason = commit_incompatibility(aggregator, wheel)
         if reason is not None:
@@ -112,12 +113,18 @@ class IntervalCommitter:
         self.aggregator = aggregator
         self.wheel = wheel
         self.chunk = int(chunk)
-        self._fused = make_fused_commit_fn(len(wheel._tiers))
+        # a LifecycleManager threads its donated last_active carry (and
+        # a traced epoch) through the SAME fused programs — activity
+        # tracking costs zero extra dispatches on the fused path
+        self.lifecycle = lifecycle
+        track = lifecycle is not None
+        self._fused = make_fused_commit_fn(len(wheel._tiers), track)
         # final-chunk variant: same fold + the query engine's snapshot
         # emission (per-tier window CDFs + the acc CDF) in ONE dispatch
         self._fused_snap = make_fused_commit_snapshot_fn(
             len(wheel._tiers), wheel.config.bucket_limit,
             wheel.config.precision, wheel.merge_path,
+            track_activity=track,
         )
         self._staging = CellStagingRing(depth=staging_depth, width=self.chunk)
 
@@ -201,6 +208,12 @@ class IntervalCommitter:
         else:
             mode, dispatches = self._commit_cells(cells, raw, dur)
         wheel.run_hooks(raw)
+        if self.lifecycle is not None:
+            # policy tick OUTSIDE every lock: eviction/compaction work
+            # never extends the commit critical section, and sharing the
+            # bridge thread means no interval's cells are in flight
+            # while rows are folded or repacked
+            self.lifecycle.on_interval()
         us = (time.perf_counter() - t0) * 1e6
         with self._metrics_lock:
             self.intervals_committed += 1
@@ -238,6 +251,10 @@ class IntervalCommitter:
                 # over the dispatch count for this interval.
                 agg._merge_cells_locked(ids, bidx64, w64)
                 agg.stats_snapshot = None  # spill path; handle is stale
+                if self.lifecycle is not None:
+                    # spill intervals can't fuse the activity stamp;
+                    # one tiny touch dispatch keeps TTLs truthful
+                    self.lifecycle.touch_locked(ids)
                 fused = False
             else:
                 with wheel._lock:
@@ -298,6 +315,10 @@ class IntervalCommitter:
         keeps = np.asarray(keeps_host, dtype=np.int32)
         ones = np.ones_like(keeps)
         wheel._note_interval_locked(raw.time, (ids, idx, w32))
+        lc = self.lifecycle
+        if lc is not None:
+            la = lc.ensure_capacity_locked(agg.num_metrics)
+            epoch = np.int32(wheel.intervals_pushed)
         emit = wheel.snapshots_enabled
         if emit:
             windows = wheel._view_windows_locked()
@@ -320,26 +341,45 @@ class IntervalCommitter:
                 )
                 chunk_keeps = keeps if dispatches == 0 else ones
                 if emit and off + take >= n:
-                    acc, rings, payloads, acc_payload = self._fused_snap(
-                        agg._acc,
-                        tuple(t.ring for t in tiers),
-                        slots,
-                        chunk_keeps,
-                        dev_ids,
-                        dev_idx,
-                        dev_w,
-                        masks,
-                    )
+                    if lc is not None:
+                        (acc, rings, la, payloads,
+                         acc_payload) = self._fused_snap(
+                            agg._acc, tuple(t.ring for t in tiers),
+                            la, slots, chunk_keeps,
+                            dev_ids, dev_idx, dev_w, epoch, masks,
+                        )
+                        lc.store_carry_locked(la)
+                    else:
+                        acc, rings, payloads, acc_payload = (
+                            self._fused_snap(
+                                agg._acc,
+                                tuple(t.ring for t in tiers),
+                                slots,
+                                chunk_keeps,
+                                dev_ids,
+                                dev_idx,
+                                dev_w,
+                                masks,
+                            )
+                        )
                 else:
-                    acc, rings = self._fused(
-                        agg._acc,
-                        tuple(t.ring for t in tiers),
-                        slots,
-                        chunk_keeps,
-                        dev_ids,
-                        dev_idx,
-                        dev_w,
-                    )
+                    if lc is not None:
+                        acc, rings, la = self._fused(
+                            agg._acc, tuple(t.ring for t in tiers),
+                            la, slots, chunk_keeps,
+                            dev_ids, dev_idx, dev_w, epoch,
+                        )
+                        lc.store_carry_locked(la)
+                    else:
+                        acc, rings = self._fused(
+                            agg._acc,
+                            tuple(t.ring for t in tiers),
+                            slots,
+                            chunk_keeps,
+                            dev_ids,
+                            dev_idx,
+                            dev_w,
+                        )
                 agg._acc = acc
                 for t, r in zip(tiers, rings):
                     t.ring = r
@@ -385,6 +425,10 @@ class IntervalCommitter:
         aggregator side.  Returns the tiers whose state was reset."""
         agg, wheel = self.aggregator, self.wheel
         agg._on_device_failure_locked()  # also drops agg.stats_snapshot
+        if self.lifecycle is not None:
+            # the activity carry was donated into the failed dispatch;
+            # rebuild it stamped "just active" (delays evictions only)
+            self.lifecycle.on_device_failure_locked()
         # the published wheel handle may describe rings this failure
         # consumed; queries fall back to locked recompute until the next
         # successful commit republishes
@@ -428,6 +472,7 @@ class IntervalCommitter:
         XLA compile while the reaper fills the freshly subscribed
         channel."""
         agg, wheel = self.aggregator, self.wheel
+        lc = self.lifecycle
         empty = np.empty(0, dtype=np.int32)
         with agg._dev_lock:
             with wheel._lock:
@@ -437,10 +482,19 @@ class IntervalCommitter:
                 dev_ids, dev_idx, dev_w = self._staging.stage(
                     empty, empty, empty
                 )
-                acc, rings = self._fused(
-                    agg._acc, tuple(t.ring for t in tiers),
-                    slots, keeps, dev_ids, dev_idx, dev_w,
-                )
+                if lc is not None:
+                    la = lc.ensure_capacity_locked(agg.num_metrics)
+                    epoch = np.int32(wheel.intervals_pushed)
+                    acc, rings, la = self._fused(
+                        agg._acc, tuple(t.ring for t in tiers), la,
+                        slots, keeps, dev_ids, dev_idx, dev_w, epoch,
+                    )
+                    lc.store_carry_locked(la)
+                else:
+                    acc, rings = self._fused(
+                        agg._acc, tuple(t.ring for t in tiers),
+                        slots, keeps, dev_ids, dev_idx, dev_w,
+                    )
                 agg._acc = acc
                 for t, r in zip(tiers, rings):
                     t.ring = r
@@ -456,10 +510,19 @@ class IntervalCommitter:
                     dev_ids, dev_idx, dev_w = self._staging.stage(
                         empty, empty, empty
                     )
-                    acc, rings, _, _ = self._fused_snap(
-                        agg._acc, tuple(t.ring for t in tiers),
-                        slots, keeps, dev_ids, dev_idx, dev_w, masks,
-                    )
+                    if lc is not None:
+                        acc, rings, la, _, _ = self._fused_snap(
+                            agg._acc, tuple(t.ring for t in tiers),
+                            lc.ensure_capacity_locked(agg.num_metrics),
+                            slots, keeps, dev_ids, dev_idx, dev_w,
+                            epoch, masks,
+                        )
+                        lc.store_carry_locked(la)
+                    else:
+                        acc, rings, _, _ = self._fused_snap(
+                            agg._acc, tuple(t.ring for t in tiers),
+                            slots, keeps, dev_ids, dev_idx, dev_w, masks,
+                        )
                     agg._acc = acc
                     for t, r in zip(tiers, rings):
                         t.ring = r

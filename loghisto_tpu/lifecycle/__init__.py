@@ -1,0 +1,30 @@
+"""Metric lifecycle subsystem (ISSUE 4): TTL eviction, device slot
+compaction, and cardinality control under name churn.
+
+The paper's lossless-counting promise meets production reality here:
+per-user / per-endpoint label churn grows the registry monotonically,
+and a dense device accumulator cannot follow it forever.  The lifecycle
+layer retires idle series (folding their lifetime state — count-exact —
+into catch-all overflow metrics), reuses the freed rows, and repacks
+the device structures when they fragment, so HBM tracks the LIVE
+population while totals keep the paper's exactness.
+
+    from loghisto_tpu.lifecycle import LifecycleConfig
+    ms = TPUMetricSystem(retention=True,
+                         lifecycle=LifecycleConfig(ttl_intervals=60,
+                                                   max_live=16384))
+"""
+
+from loghisto_tpu.lifecycle.policy import (
+    LifecycleConfig,
+    decide_victims,
+    default_overflow_name,
+)
+from loghisto_tpu.lifecycle.manager import LifecycleManager
+
+__all__ = [
+    "LifecycleConfig",
+    "LifecycleManager",
+    "decide_victims",
+    "default_overflow_name",
+]

@@ -56,11 +56,12 @@ DROP_ID = np.int32(2**30)
 
 
 @functools.lru_cache(maxsize=None)
-def make_fused_commit_fn(num_tiers: int):
+def make_fused_commit_fn(num_tiers: int, track_activity: bool = False):
     """Build the fused commit program for ``num_tiers`` retention tiers.
-    Cached per tier count: the jitted program is shape-polymorphic, so
-    every committer with the same number of tiers shares one jit object
-    (and its per-shape executable cache) instead of recompiling.
+    Cached per (tier count, activity flag): the jitted program is
+    shape-polymorphic, so every committer with the same signature shares
+    one jit object (and its per-shape executable cache) instead of
+    recompiling.
 
     Returns ``commit(acc, rings, slots, keeps, ids, idx, weights) ->
     (acc, rings)`` where
@@ -87,7 +88,35 @@ def make_fused_commit_fn(num_tiers: int):
     row count (registry growth), in which case those cells land in the
     accumulator and fall off every ring — the same semantics the
     separate paths had.
+
+    With ``track_activity`` the signature gains a donated int32 [M]
+    ``last_active`` carry and a traced int32 ``epoch`` —
+    ``commit(acc, rings, last_active, slots, keeps, ids, idx, weights,
+    epoch) -> (acc, rings, last_active)`` — and the program additionally
+    stamps ``last_active[ids] = max(., epoch)`` over the interval's
+    touched rows.  Same cells, same dispatch: the lifecycle subsystem's
+    activity vector costs zero extra launches, the identical fusion
+    economics as the snapshot variant's commit-time CDFs.
     """
+
+    if track_activity:
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def commit_la(acc, rings, last_active, slots, keeps, ids, idx,
+                      weights, epoch):
+            acc = acc.at[ids, idx].add(weights, mode="drop")
+            new_rings = []
+            for t in range(num_tiers):
+                ring = rings[t]
+                ring = ring.at[slots[t]].multiply(keeps[t], mode="drop")
+                ring = ring.at[slots[t], ids, idx].add(
+                    weights, mode="drop"
+                )
+                new_rings.append(ring)
+            last_active = last_active.at[ids].max(epoch, mode="drop")
+            return acc, tuple(new_rings), last_active
+
+        return commit_la
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def commit(acc, rings, slots, keeps, ids, idx, weights):
@@ -109,6 +138,7 @@ def make_fused_commit_snapshot_fn(
     bucket_limit: int,
     precision: int = PRECISION,
     merge_path: str = "jnp",
+    track_activity: bool = False,
 ):
     """The fused commit program's FINAL-chunk variant: same donated-carry
     fold as ``make_fused_commit_fn`` plus, in the SAME dispatch, the
@@ -128,7 +158,39 @@ def make_fused_commit_snapshot_fn(
     ``ops.stats.dense_cdf``.  The payload outputs are fresh (never
     donated), which is what lets the store publish them as a lock-free
     immutable handle while later commits keep donating the carries.
+
+    ``track_activity`` threads the lifecycle's donated ``last_active``
+    carry and traced ``epoch`` through exactly as in
+    ``make_fused_commit_fn`` — the final chunk of an interval then pays
+    the scatter fold, every snapshot payload, AND the activity stamp in
+    one dispatch.
     """
+
+    if track_activity:
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def commit_la(acc, rings, last_active, slots, keeps, ids, idx,
+                      weights, epoch, masks):
+            acc = acc.at[ids, idx].add(weights, mode="drop")
+            new_rings = []
+            payloads = []
+            for t in range(num_tiers):
+                ring = rings[t]
+                ring = ring.at[slots[t]].multiply(keeps[t], mode="drop")
+                ring = ring.at[slots[t], ids, idx].add(
+                    weights, mode="drop"
+                )
+                new_rings.append(ring)
+                payloads.append(
+                    window_snapshot(ring, masks[t], bucket_limit,
+                                    precision, merge_path)
+                )
+            last_active = last_active.at[ids].max(epoch, mode="drop")
+            acc_payload = dense_cdf(acc, bucket_limit, precision)
+            return (acc, tuple(new_rings), last_active, tuple(payloads),
+                    acc_payload)
+
+        return commit_la
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def commit(acc, rings, slots, keeps, ids, idx, weights, masks):

@@ -1421,7 +1421,7 @@ class TPUAggregator:
                 mid = int(mid)
                 count = int(counts[mid])
                 total = float(sums[mid])
-                if mid < len(names):
+                if mid < len(names) and names[mid] is not None:
                     name = names[mid]
                     metrics[f"{name}_count"] = float(count)
                     metrics[f"{name}_sum"] = total

@@ -3,14 +3,25 @@
 The reference keys everything by string name in sparse maps
 (metrics.go:112-126).  The device tier instead stores bucket counts in a
 dense ``[num_metrics, num_buckets]`` tensor, so names map to stable integer
-rows.  The registry is append-only (ids are never reused) and thread-safe;
-capacity is fixed so the device accumulator shape is static under jit.
+rows.  The registry is thread-safe; capacity is bounded so the device
+accumulator shape is static under jit.
+
+Lifecycle (ISSUE 4): the registry is no longer strictly append-only.
+``evict()`` releases ids back to a free-list (reused by ``id_for``
+before the row space grows) and ``apply_permutation()`` remaps every
+live id after a device compaction.  Both bump ``generation`` — the
+invalidation signal every id-keyed cache (glob resolution, query plan
+/ result caches, snapshot handles) must key on: an id is only
+meaningful for a fixed generation.  Pure appends do NOT bump the
+generation (previously resolved ids stay valid; caches may extend
+incrementally by scanning the new tail), which preserves the
+append-only fast path the query engine was built on.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional, Sequence
 
 
 class RegistryFullError(RuntimeError):
@@ -24,10 +35,23 @@ class MetricRegistry:
         self.capacity = capacity
         self._lock = threading.Lock()
         self._name_to_id: Dict[str, int] = {}
-        self._names: List[str] = []
+        # dense id -> name table; None marks a freed (evictable-reuse) slot
+        self._names: List[Optional[str]] = []
+        # freed slot ids, reused LIFO before the table grows a new row
+        self._free: List[int] = []
+        self._generation = 0
+
+    @property
+    def generation(self) -> int:
+        """Structural generation: bumped whenever an existing id's
+        meaning changes (eviction, free-slot reuse, permutation) — NOT
+        on pure appends.  Caches must treat any id resolved under a
+        different generation as dead."""
+        return self._generation
 
     def id_for(self, name: str) -> int:
-        """Return the row id for `name`, registering it on first use."""
+        """Return the row id for `name`, registering it on first use.
+        Freed slots are reused (LIFO) before the table grows."""
         existing = self._name_to_id.get(name)
         if existing is not None:
             return existing
@@ -35,33 +59,133 @@ class MetricRegistry:
             existing = self._name_to_id.get(name)
             if existing is not None:
                 return existing
-            if len(self._names) >= self.capacity:
-                raise RegistryFullError(
-                    f"metric registry is full ({self.capacity} names)"
-                )
-            new_id = len(self._names)
-            self._names.append(name)
+            if self._free:
+                new_id = self._free.pop()
+                self._names[new_id] = name
+                # an old generation's caches may still map this id to the
+                # evicted tenant; reuse is a structural change
+                self._generation += 1
+            else:
+                if len(self._names) >= self.capacity:
+                    raise RegistryFullError(
+                        f"metric registry is full ({self.capacity} names)"
+                    )
+                new_id = len(self._names)
+                self._names.append(name)
             self._name_to_id[name] = new_id
             return new_id
 
     def grow(self, new_capacity: int) -> None:
-        """Raise capacity (never shrinks; ids are stable).  Used by the
-        aggregator's on_registry_full="grow" policy — the reference admits
-        new names forever (metrics.go:281-294), so the device tier grows
-        its row space geometrically instead of hard-failing."""
+        """Raise capacity (never shrinks here; ``apply_permutation`` owns
+        shrinks).  Used by the aggregator's on_registry_full="grow"
+        policy — the reference admits new names forever
+        (metrics.go:281-294), so the device tier grows its row space
+        geometrically instead of hard-failing.  The free-list and
+        generation counter are deliberately untouched: growth neither
+        invalidates an id nor forfeits reclaimed slots."""
         with self._lock:
             if new_capacity > self.capacity:
                 self.capacity = new_capacity
 
+    def evict(self, ids: Iterable[int]) -> List[str]:
+        """Release the given ids: their names unregister, the slots join
+        the free-list, and the generation bumps once.  Unknown / already
+        free ids are ignored.  Returns the evicted names."""
+        evicted: List[str] = []
+        with self._lock:
+            for mid in ids:
+                mid = int(mid)
+                if not 0 <= mid < len(self._names):
+                    continue
+                name = self._names[mid]
+                if name is None:
+                    continue
+                del self._name_to_id[name]
+                self._names[mid] = None
+                self._free.append(mid)
+                evicted.append(name)
+            if evicted:
+                self._generation += 1
+        return evicted
+
+    def apply_permutation(
+        self, perm: Sequence[int], new_capacity: Optional[int] = None
+    ) -> None:
+        """Remap every live id after a device compaction: ``perm[new]``
+        is the OLD id now living at row ``new`` (negative = empty row).
+        Every old live id must appear exactly once or the mapping would
+        silently drop or duplicate series — validated.  Rebuilds the
+        free-list from the holes and bumps the generation."""
+        with self._lock:
+            old_live = {
+                mid for mid, name in enumerate(self._names)
+                if name is not None
+            }
+            # out-of-range entries (negative, or the DROP sentinel) mark
+            # empty rows; only in-range sources must be unique
+            sources = [
+                int(p) for p in perm
+                if 0 <= int(p) < len(self._names)
+            ]
+            if len(sources) != len(set(sources)):
+                raise ValueError("compaction permutation duplicates a row")
+            live_sources = {s for s in sources if s in old_live}
+            if live_sources != old_live:
+                missing = sorted(old_live - live_sources)[:8]
+                raise ValueError(
+                    f"compaction permutation drops live ids {missing}"
+                )
+            cap = int(new_capacity) if new_capacity is not None \
+                else self.capacity
+            if cap < len(perm):
+                raise ValueError(
+                    f"new capacity {cap} below permutation length "
+                    f"{len(perm)}"
+                )
+            names: List[Optional[str]] = [None] * len(perm)
+            for new_id, old_id in enumerate(perm):
+                old_id = int(old_id)
+                if old_id < 0 or old_id >= len(self._names):
+                    continue
+                names[new_id] = self._names[old_id]
+            # trim trailing holes so append-path ids stay dense
+            while names and names[-1] is None:
+                names.pop()
+            self._names = names
+            self._name_to_id = {
+                name: mid for mid, name in enumerate(names)
+                if name is not None
+            }
+            self._free = [
+                mid for mid, name in enumerate(names) if name is None
+            ]
+            self.capacity = cap
+            self._generation += 1
+
     def lookup(self, name: str) -> Optional[int]:
         return self._name_to_id.get(name)
 
-    def name_for(self, metric_id: int) -> str:
-        return self._names[metric_id]
+    def name_for(self, metric_id: int) -> Optional[str]:
+        """Name at a row id, or None for a freed / never-used slot."""
+        if 0 <= metric_id < len(self._names):
+            return self._names[metric_id]
+        return None
 
-    def names(self) -> List[str]:
+    def names(self) -> List[Optional[str]]:
+        """Dense id -> name table; freed slots hold None.  Callers that
+        report by name must skip the holes."""
         with self._lock:
             return list(self._names)
 
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def live_count(self) -> int:
+        with self._lock:
+            return len(self._name_to_id)
+
     def __len__(self) -> int:
+        """High-water row count (table length INCLUDING freed holes) —
+        the append-only growth proxy caches pair with ``generation``."""
         return len(self._names)

@@ -49,6 +49,7 @@ class TPUMetricSystem(MetricSystem):
         fast_ingest: bool = False,
         retention=None,
         commit: str = "auto",
+        lifecycle=None,
     ):
         """``retention`` turns on the windowed retention tier:
         ``True`` builds a TimeWheel with the default 60x1 / 60x60 /
@@ -66,7 +67,17 @@ class TPUMetricSystem(MetricSystem):
         follows the capture-overridable switch in ops/dispatch.py and
         stays on the fan-out for sharded state.  Without retention the
         aggregator is the only device consumer, so the fan-out IS one
-        dispatch already and ``commit`` is moot."""
+        dispatch already and ``commit`` is moot.
+
+        ``lifecycle`` takes a ``lifecycle.LifecycleConfig`` and turns on
+        the metric lifecycle subsystem: per-interval activity tracking
+        rides the fused commit (zero extra dispatches), TTL/idle and
+        cardinality policies retire churned series into catch-all
+        overflow metrics (count-exact), freed device rows are reused and
+        periodically compacted, and a ``lifecycle.*`` gauge family
+        reports the churn.  Requires retention + the fused commit path
+        (the subsystem's clock and activity signal ARE the committed
+        intervals)."""
         super().__init__(
             interval=interval, sys_stats=sys_stats, config=config,
             fast_ingest=fast_ingest,
@@ -120,6 +131,12 @@ class TPUMetricSystem(MetricSystem):
         self.commit_path = resolve_commit_path(
             commit, platform, mesh=mesh is not None
         )
+        self.lifecycle = None
+        if lifecycle is not None and self.retention is None:
+            raise ValueError(
+                "lifecycle needs retention: construct with "
+                "TPUMetricSystem(retention=True, lifecycle=...)"
+            )
         if self.commit_path == "fused" and self.retention is not None:
             from loghisto_tpu.commit import (
                 IntervalCommitter, commit_incompatibility,
@@ -127,10 +144,19 @@ class TPUMetricSystem(MetricSystem):
 
             reason = commit_incompatibility(self.aggregator, self.retention)
             if reason is None:
+                if lifecycle is not None:
+                    from loghisto_tpu.lifecycle import LifecycleManager
+
+                    self.lifecycle = LifecycleManager(
+                        self.aggregator, self.retention, lifecycle,
+                        metric_system=self,
+                    )
+                    self.lifecycle.register_gauges(self)
                 # ONE subscription pays both consumers: neither the
                 # aggregator bridge nor the wheel bridge attaches
                 self.committer = IntervalCommitter(
-                    self.aggregator, self.retention
+                    self.aggregator, self.retention,
+                    lifecycle=self.lifecycle,
                 )
                 self.committer.attach(self)
                 self.committer.register_gauges(self)
@@ -146,6 +172,13 @@ class TPUMetricSystem(MetricSystem):
                 # the "fan-out" is already a single dispatch per interval
                 self.commit_path = "fanout"
         if self.committer is None:
+            if lifecycle is not None:
+                raise ValueError(
+                    "lifecycle rides the fused interval commit; this "
+                    f"configuration resolved commit={self.commit_path!r}"
+                    " (mesh-sharded and fan-out pipelines don't carry "
+                    "the activity vector)"
+                )
             self.aggregator.attach(self)
             if self.retention is not None:
                 self.retention.attach(self)

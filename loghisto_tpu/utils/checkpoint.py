@@ -32,8 +32,16 @@ def save(
     path: str,
     metric_system: Optional[MetricSystem] = None,
     aggregator=None,
+    lifecycle=None,
 ) -> None:
-    """Atomically snapshot lifetime state to `path` (.npz)."""
+    """Atomically snapshot lifetime state to `path` (.npz).
+
+    ``lifecycle`` (a lifecycle.LifecycleManager) additionally persists
+    the activity vector, the lifetime churn counters, and the registry
+    generation.  Overflow metric state needs no special handling — the
+    catch-all series are ordinary named rows, so they ride the
+    accumulator / lifetime-aggregate payloads like any other metric
+    (tests/test_checkpoint.py round-trips this)."""
     payload = {"version": np.int64(FORMAT_VERSION)}
 
     if metric_system is not None:
@@ -80,13 +88,31 @@ def save(
         with aggregator._agg_lock:
             agg_items = sorted(aggregator._agg.items())
         payload["agg_acc"] = acc
+        # freed lifecycle slots serialize as JSON null and restore as
+        # holes (their rows are zero — eviction folds then clears them)
         payload["agg_names"] = _names_arr(aggregator.registry.names())
+        payload["agg_registry_generation"] = np.int64(
+            getattr(aggregator.registry, "generation", 0)
+        )
         payload["agg_ids"] = np.array([k for k, _ in agg_items], dtype=np.int64)
         payload["agg_sums"] = np.array(
             [v[0] for _, v in agg_items], dtype=np.float64
         )
         payload["agg_counts"] = np.array(
             [v[1] for _, v in agg_items], dtype=np.uint64
+        )
+
+    if lifecycle is not None:
+        st = lifecycle.state_dict()
+        payload["lc_last_active"] = st["last_active"]
+        payload["lc_counters"] = np.array(
+            [
+                st["evicted_series"],
+                st["overflowed_samples"],
+                st["evictions"],
+                st["compactions"],
+            ],
+            dtype=np.int64,
         )
 
     directory = os.path.dirname(os.path.abspath(path)) or "."
@@ -109,9 +135,15 @@ def restore(
     path: str,
     metric_system: Optional[MetricSystem] = None,
     aggregator=None,
+    lifecycle=None,
 ) -> None:
     """Restore lifetime state saved by save().  Loads into the provided
-    objects (merging over their current lifetime state)."""
+    objects (merging over their current lifetime state).  With
+    ``lifecycle``, the saved activity vector is remapped through the
+    same by-name row mapping as the accumulator and the churn counters
+    are restored; the target registry's generation is advanced to at
+    least the saved one, so caches keyed on (generation, length) from a
+    pre-restore world can never serve post-restore ids."""
     with np.load(path, allow_pickle=False) as data:
         version = int(data["version"])
         if version != FORMAT_VERSION:
@@ -163,6 +195,10 @@ def restore(
             saved_names = _arr_names(data["agg_names"])
             row_map = []
             for saved_id, name in enumerate(saved_names):
+                if name is None:
+                    # lifecycle-freed slot: its row was folded into an
+                    # overflow metric and zeroed before the save
+                    continue
                 new_id = aggregator._id_for(name)
                 if new_id < 0:
                     import logging
@@ -252,6 +288,27 @@ def restore(
                     # collect would TypeError on floats)
                     entry[0] += int(s) if agg_compat else float(s)
                     entry[1] += int(c)
+            if "agg_registry_generation" in data:
+                saved_gen = int(data["agg_registry_generation"])
+                reg = aggregator.registry
+                with reg._lock:
+                    reg._generation = max(reg._generation, saved_gen)
+            if lifecycle is not None and "lc_last_active" in data:
+                saved_la = np.asarray(
+                    data["lc_last_active"], dtype=np.int32
+                )
+                la = np.zeros(aggregator.num_metrics, dtype=np.int32)
+                for saved_id, new_id in id_remap.items():
+                    if saved_id < len(saved_la) and new_id < len(la):
+                        la[new_id] = saved_la[saved_id]
+                counters = data["lc_counters"]
+                lifecycle.load_state({
+                    "last_active": la,
+                    "evicted_series": int(counters[0]),
+                    "overflowed_samples": int(counters[1]),
+                    "evictions": int(counters[2]),
+                    "compactions": int(counters[3]),
+                })
 
 
 def _names_arr(names) -> np.ndarray:

@@ -545,30 +545,53 @@ class TimeWheel:
         self._snapshot = None
 
     def _resolve_glob(self, pattern: str):
-        """Glob -> ((mid, name), ...) memoized per registry generation
-        (== len(names); the registry is append-only, so an unchanged
-        generation means an unchanged match list and a grown one only
-        needs the new tail scanned).  Rows beyond the wheel's metric
-        capacity are filtered here once, not per query."""
+        """Glob -> ((mid, name), ...) memoized per registry state.  The
+        cache key is ``(structural_generation, high_water)``: while the
+        structural generation is unchanged the registry behaved
+        append-only, so an equal high-water means an unchanged match
+        list and a grown one only needs the new tail scanned.  Eviction,
+        free-slot reuse, and compaction bump the structural generation,
+        which forces a full rescan here — a resolved id must never
+        outlive the generation it was resolved under (a stale hit would
+        serve an evicted row, or a reused row under its old name).
+        Freed slots read as None and are skipped.  Rows beyond the
+        wheel's metric capacity are filtered here once, not per
+        query."""
         names = self.registry.names()
-        gen = len(names)
+        rgen = getattr(self.registry, "generation", 0)
+        hw = len(names)
+        gen = (rgen, hw)
         ent = self._glob_cache.get(pattern)
         if ent is not None and ent[0] == gen:
             return gen, ent[1]
-        if ent is not None and ent[0] < gen:
+        if ent is not None and ent[0][0] == rgen and ent[0][1] < hw:
             matched = list(ent[1])
-            start = ent[0]
+            start = ent[0][1]
         else:
             matched = []
             start = 0
-        for mid in range(start, gen):
-            if mid < self.num_metrics and fnmatch.fnmatch(names[mid], pattern):
-                matched.append((mid, names[mid]))
+        for mid in range(start, hw):
+            name = names[mid]
+            if name is None or mid >= self.num_metrics:
+                continue
+            if fnmatch.fnmatch(name, pattern):
+                matched.append((mid, name))
         matches = tuple(matched)
         if len(self._glob_cache) >= 256 and pattern not in self._glob_cache:
             self._glob_cache.clear()
         self._glob_cache[pattern] = (gen, matches)
         return gen, matches
+
+    def lifecycle_invalidated_locked(self) -> None:
+        """Called (store lock held) after lifecycle eviction or
+        compaction mutated ring rows in place: the published snapshot
+        describes pre-eviction state, and every cached glob resolution /
+        host result maps dead or remapped ids.  Drop all three — the
+        next commit republishes; queries in between take the locked
+        recompute path against the post-eviction rings."""
+        self._glob_cache.clear()
+        self._result_cache.clear()
+        self.invalidate_snapshot_locked()
 
     # -- queries -------------------------------------------------------- #
 
@@ -712,6 +735,8 @@ class TimeWheel:
         keys = [pct_key(p) for p in ps]
         metrics: Dict[str, Dict[str, float]] = {}
         for mid, name in enumerate(names):
+            if name is None:  # lifecycle-freed slot
+                continue
             if mid >= len(counts) or not fnmatch.fnmatch(name, pattern):
                 continue
             count = int(counts[mid])
