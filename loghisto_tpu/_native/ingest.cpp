@@ -15,8 +15,10 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <memory>
 #include <mutex>
 #include <new>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -311,6 +313,170 @@ int64_t lh_cells_drain_packed(void* store, int32_t* out) {
   }
   cs->used = remaining;
   return m;
+}
+
+}  // extern "C"
+
+// -- pipelined sparse-delta transport (PR 6) -------------------------------
+//
+// The fold below is the host half of transport="sparse": one GIL-released
+// call turns a raw (ids, values) batch into packed int32 [n, 3]
+// (id, codec_bucket, count) triples — the r5 wire format — using T
+// thread-local CellStores over disjoint batch slices.  Thread-local
+// tables need no locks; duplicate (id, bucket) cells across slices cost
+// only wire rows (the device merge is additive), the same bounded-
+// duplication trade the sharded record-time store already makes.
+
+namespace {
+
+// Rows needed to emit one table under the 2^30-1 per-row count cap
+// (split rule shared with lh_cells_drain_packed).
+int64_t packed_rows_needed(const CellStore& cs, int64_t cap) {
+  int64_t rows = 0;
+  for (const CellSlot& s : cs.table) {
+    if (s.key == 0) continue;
+    rows += (s.count + cap - 1) / cap;
+  }
+  return rows;
+}
+
+// Emit every cell as split [id, bucket, count<=cap] triples at out;
+// clears the table (capacity retained).  Returns rows written.
+int64_t emit_packed_split(CellStore& cs, int64_t cap, int32_t* out) {
+  int64_t m = 0;
+  for (CellSlot& s : cs.table) {
+    if (s.key == 0) continue;
+    int64_t c = s.count;
+    while (c > 0) {
+      int64_t emit = c > cap ? cap : c;
+      out[3 * m] = static_cast<int32_t>(s.key >> 16);
+      out[3 * m + 1] = static_cast<int32_t>(s.key & 0xFFFF) - 32768;
+      out[3 * m + 2] = static_cast<int32_t>(emit);
+      c -= emit;
+      ++m;
+    }
+    s.key = 0;
+    s.count = 0;
+  }
+  cs.used = 0;
+  return m;
+}
+
+}  // namespace
+
+extern "C" {
+
+void lh_packed_free(int32_t* p) { delete[] p; }
+
+// Fold a raw batch into packed triples with `num_threads` parallel
+// thread-local tables.  *out receives a buffer allocated here (release
+// with lh_packed_free).  Returns the row count, or -1 when an
+// allocation failed (nothing is leaked; the caller falls back to the
+// NumPy tier or raw transport).
+int64_t lh_fold_packed(const int32_t* ids, const float* values, int64_t n,
+                       int precision, int bucket_limit, int num_threads,
+                       int32_t** out) {
+  const int64_t cap = LH_PACKED_COUNT_CAP;
+  if (num_threads < 1) num_threads = 1;
+  // below ~64k samples/thread the spawn+merge overhead beats the win
+  int64_t max_t = n / 65536 + 1;
+  if (num_threads > max_t) num_threads = static_cast<int>(max_t);
+  std::vector<std::unique_ptr<CellStore>> stores;
+  std::atomic<bool> failed{false};
+  try {
+    for (int t = 0; t < num_threads; ++t)
+      stores.emplace_back(new CellStore(1 << 14));
+  } catch (...) {
+    return -1;
+  }
+  auto fold_slice = [&](int t) {
+    int64_t lo = n * t / num_threads;
+    int64_t hi = n * (t + 1) / num_threads;
+    CellStore& cs = *stores[t];
+    for (int64_t i = lo; i < hi; ++i) {
+      int32_t id = ids[i];
+      if (id < 0) continue;
+      int32_t b = compress_one(static_cast<double>(values[i]), precision);
+      if (b < -bucket_limit) b = -bucket_limit;
+      if (b > bucket_limit) b = bucket_limit;
+      uint64_t key =
+          (static_cast<uint64_t>(static_cast<uint32_t>(id)) << 16) |
+          static_cast<uint16_t>(b + 32768);
+      if (!cs.add_one(key, 1)) {
+        failed.store(true);
+        return;
+      }
+    }
+  };
+  if (num_threads == 1) {
+    fold_slice(0);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(num_threads);
+    for (int t = 0; t < num_threads; ++t)
+      threads.emplace_back(fold_slice, t);
+    for (auto& th : threads) th.join();
+  }
+  if (failed.load()) return -1;
+  int64_t total = 0;
+  std::vector<int64_t> offsets(num_threads);
+  for (int t = 0; t < num_threads; ++t) {
+    offsets[t] = total;
+    total += packed_rows_needed(*stores[t], cap);
+  }
+  int32_t* buf = new (std::nothrow) int32_t[3 * std::max<int64_t>(total, 1)];
+  if (!buf) return -1;
+  if (num_threads == 1) {
+    emit_packed_split(*stores[0], cap, buf);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(num_threads);
+    for (int t = 0; t < num_threads; ++t)
+      threads.emplace_back([&, t] {
+        emit_packed_split(*stores[t], cap, buf + 3 * offsets[t]);
+      });
+    for (auto& th : threads) th.join();
+  }
+  *out = buf;
+  return total;
+}
+
+// Parallel drain of `num_stores` detached CellStore handles into one
+// packed buffer (allocated here; release with lh_packed_free) — the
+// ShardedCellStore's whole-store drain in one GIL-released call, shards
+// scanned concurrently.  Returns total rows or -1 on allocation failure
+// (the stores are left untouched in that case: sizing happens before
+// any table is cleared).
+int64_t lh_cells_drain_packed_multi(void** stores, int num_stores,
+                                    int num_threads, int32_t** out) {
+  const int64_t cap = LH_PACKED_COUNT_CAP;
+  if (num_stores < 1) return 0;
+  if (num_threads < 1) num_threads = 1;
+  if (num_threads > num_stores) num_threads = num_stores;
+  std::vector<int64_t> offsets(num_stores);
+  int64_t total = 0;
+  for (int i = 0; i < num_stores; ++i) {
+    offsets[i] = total;
+    total += packed_rows_needed(*static_cast<CellStore*>(stores[i]), cap);
+  }
+  int32_t* buf = new (std::nothrow) int32_t[3 * std::max<int64_t>(total, 1)];
+  if (!buf) return -1;
+  auto drain_range = [&](int t) {
+    for (int i = t; i < num_stores; i += num_threads)
+      emit_packed_split(*static_cast<CellStore*>(stores[i]), cap,
+                        buf + 3 * offsets[i]);
+  };
+  if (num_threads == 1) {
+    drain_range(0);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(num_threads);
+    for (int t = 0; t < num_threads; ++t)
+      threads.emplace_back(drain_range, t);
+    for (auto& th : threads) th.join();
+  }
+  *out = buf;
+  return total;
 }
 
 // Dense accumulate on host: the CPU fallback / verification twin of the

@@ -65,6 +65,25 @@ MATMUL_MAX_CELLS = 1 << 21
 # committed-JSON machinery as the ingest thresholds.
 FUSED_COMMIT = True
 
+# Host->device transport crossover (r6): "auto" transport folds each
+# raw flush on host and measures cell density = unique_cells / samples.
+# At or below this crossover the batch is skewed enough that shipping
+# packed [n,3] triples (transport="sparse", 12B/cell) beats shipping
+# every sample (8B/sample) — both on wire bytes and on device work
+# (weighted scatter over cells vs per-sample compress+scatter).  Above
+# it the fold overhead isn't paid back and raw stays.  0.5 is the
+# conservative break-even from the wire-bytes ratio alone
+# (12*density < 8 => density < 2/3, minus fold-cost margin); a capture
+# retunes it via the committed-JSON table like every other threshold.
+SPARSE_DENSITY_CROSSOVER = 0.5
+
+# Which device tier the sparse transport's packed-triple scatter uses:
+# "jnp" (XLA weighted scatter-add) or "pallas" (per-cell DMA row
+# round-trip, ops/sparse_ingest.py).  The Pallas tier is bit-identical
+# but not yet hardware-ranked, so auto stays on jnp until a capture
+# flips this.
+SPARSE_KERNEL = "jnp"
+
 # Capture-derived threshold table (VERDICT r2 item 7): refreshing the
 # dispatch policy after a hardware capture is a committed JSON (emitted
 # by ``benchmarks/analyze_capture.py --emit-thresholds``), not a code
@@ -80,6 +99,7 @@ THRESHOLDS_SOURCE = "baked-in defaults"
 def _load_thresholds() -> None:
     global SORT_MIN_METRICS, PALLAS_SINGLE_METRIC, THRESHOLDS_SOURCE
     global HIGH_CARDINALITY_KERNEL, FUSED_COMMIT
+    global SPARSE_DENSITY_CROSSOVER, SPARSE_KERNEL
     try:
         with open(THRESHOLDS_FILE) as f:
             table = _json.load(f)
@@ -103,6 +123,19 @@ def _load_thresholds() -> None:
     fc = table.get("fused_commit")
     if isinstance(fc, bool):
         FUSED_COMMIT = fc
+        applied = True
+    sdc = table.get("sparse_density_crossover")
+    # bool is an int subclass; a stray true/false must not become 1.0/0.0
+    if (
+        isinstance(sdc, (int, float))
+        and not isinstance(sdc, bool)
+        and 0.0 <= sdc <= 1.0
+    ):
+        SPARSE_DENSITY_CROSSOVER = float(sdc)
+        applied = True
+    sk = table.get("sparse_kernel")
+    if sk in ("jnp", "pallas"):
+        SPARSE_KERNEL = sk
         applied = True
     if applied:  # never cite a table that contributed nothing
         THRESHOLDS_SOURCE = str(table.get("source", THRESHOLDS_FILE))
@@ -199,6 +232,43 @@ def resolve_ingest_path(
             "automatically, but the starting shape must be [1, B])"
         )
     return path
+
+
+def resolve_sparse_kernel(kernel: str) -> str:
+    """Resolve the sparse transport's device tier ("auto" follows the
+    capture-overridable SPARSE_KERNEL switch)."""
+    if kernel == "auto":
+        return SPARSE_KERNEL
+    if kernel not in ("jnp", "pallas"):
+        raise ValueError(
+            f"unknown sparse kernel {kernel!r}: expected 'auto', 'jnp', "
+            "or 'pallas'"
+        )
+    return kernel
+
+
+def choose_transport(
+    platform: str, density: float | None = None, native_ok: bool = True
+) -> str:
+    """Pick the host->device transport for transport="auto".
+
+    ``density`` is the measured unique-cells / samples ratio of a probe
+    flush (None before any probe has run).  The policy: start on "raw"
+    (zero host fold cost, always correct), and switch to "sparse" once a
+    probe shows the load is skewed enough that shipping packed triples
+    wins (density <= SPARSE_DENSITY_CROSSOVER).  "preagg" is never
+    auto-picked: it trades flush latency for record()-time fold work,
+    which only pays off when the *recording* threads are the bottleneck
+    — a workload property no flush-side probe can see — so it stays an
+    explicit opt-in.  ``native_ok=False`` (no compiler AND numpy tier
+    unavailable — today never, the numpy tier always exists) pins raw.
+    """
+    del platform  # crossover is wire/fold-cost driven, not device-driven
+    if not native_ok:
+        return "raw"
+    if density is not None and density <= SPARSE_DENSITY_CROSSOVER:
+        return "sparse"
+    return "raw"
 
 
 def resolve_commit_path(path: str, platform: str, mesh: bool = False) -> str:

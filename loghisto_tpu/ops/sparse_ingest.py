@@ -1,0 +1,171 @@
+"""Sparse-delta device program (transport="sparse"): donated scatter-add
+over packed int32 [n, 3] (id, codec_bucket, count) triples.
+
+The raw transport ships every sample and pays a per-sample device
+compress; the sparse transport folds the batch on host first (_native
+``fold_packed`` — parallel C tier or pure NumPy) and ships only the
+unique cells, so the device program is a WEIGHTED scatter over O(cells)
+rows with no codec work at all.  For Zipf-shaped load the cell count is
+a small fraction of the sample count, which moves both the wire bytes
+and the device FLOPs from O(samples) to O(unique cells).
+
+Two tiers, bit-identical by construction (tests/test_ingest_transport.py
+pins the parity):
+
+  * "jnp"    — XLA scatter-add, identical math to ops.ingest's
+    make_packed_ingest_fn; works on every platform and is what "auto"
+    dispatches today.
+  * "pallas" — a TPU Pallas kernel that keeps the accumulator in HBM and
+    round-trips one bucket row per cell through a VMEM scratch via
+    explicit DMA.  Exact (integer adds, serial grid), but NOT yet
+    hardware-ranked against the XLA scatter — it exists so a capture can
+    rank it (benchmarks/device_paths.py pattern); "auto" will not pick
+    it until a committed threshold table says so (ops/dispatch.py
+    SPARSE_KERNEL).  Off-TPU it runs in interpret mode so CI exercises
+    the same code path.
+
+Padding rows use id -1, which ``sanitize_ids`` (jnp tier) or the
+explicit bounds guard (Pallas tier) drops; callers route counts >= 2^30
+to the exact host spill first, so the int32 count column cannot
+overflow (the _native drain's split rule caps every wire row below
+that).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from loghisto_tpu.ops.ingest import sanitize_ids
+
+# Triples per Pallas grid step: small enough that the SMEM operand
+# blocks stay trivial, large enough to amortize grid overhead.
+TRIPLE_TILE = 256
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def sparse_ingest_batch(
+    acc: jnp.ndarray, packed: jnp.ndarray, bucket_limit: int
+) -> jnp.ndarray:
+    """Pure jnp tier: weighted scatter-add of packed triples into the
+    dense accumulator (the math of ops.ingest.make_packed_ingest_fn)."""
+    if packed.ndim != 2 or packed.shape[1] != 3:
+        raise ValueError(
+            f"packed must be [n, 3] (id, bucket, count); got {packed.shape}"
+        )
+    idx = jnp.clip(packed[:, 1], -bucket_limit, bucket_limit) + bucket_limit
+    return acc.at[sanitize_ids(packed[:, 0]), idx].add(
+        packed[:, 2], mode="drop"
+    )
+
+
+def _pallas_kernel(ids_ref, idx_ref, w_ref, acc_in_ref, acc_out_ref,
+                   row_ref, sem_in, sem_out, *, num_metrics: int):
+    """One grid step: apply TRIPLE_TILE cells to the HBM accumulator.
+
+    Per cell: DMA the target bucket row HBM->VMEM, integer-add the
+    weight at the (dynamic) dense column, DMA the row back.  The TPU
+    grid is sequential and each DMA pair completes before the next cell
+    starts, so duplicate rows within or across tiles accumulate exactly
+    — no atomics needed.  acc_in/acc_out alias (input_output_aliases),
+    so all traffic goes through acc_out_ref and the input ref is only
+    the donation anchor."""
+    del acc_in_ref
+
+    def body(j, carry):
+        mid = ids_ref[0, j]
+
+        @pl.when((mid >= 0) & (mid < num_metrics))
+        def _apply():
+            load = pltpu.make_async_copy(
+                acc_out_ref.at[pl.ds(mid, 1)], row_ref, sem_in
+            )
+            load.start()
+            load.wait()
+            col = idx_ref[0, j]
+            row_ref[0, col] += w_ref[0, j]
+            store = pltpu.make_async_copy(
+                row_ref, acc_out_ref.at[pl.ds(mid, 1)], sem_out
+            )
+            store.start()
+            store.wait()
+
+        return carry
+
+    jax.lax.fori_loop(0, ids_ref.shape[1], body, 0)
+
+
+def pallas_sparse_ingest(
+    acc: jnp.ndarray, packed: jnp.ndarray, bucket_limit: int
+) -> jnp.ndarray:
+    """Pallas tier: same contract as sparse_ingest_batch.  packed length
+    is padded to TRIPLE_TILE inside (pad id -1 drops)."""
+    if packed.ndim != 2 or packed.shape[1] != 3:
+        raise ValueError(
+            f"packed must be [n, 3] (id, bucket, count); got {packed.shape}"
+        )
+    n = packed.shape[0]
+    g = max(1, (n + TRIPLE_TILE - 1) // TRIPLE_TILE)
+    padded = g * TRIPLE_TILE
+    if padded != n:
+        pad = jnp.zeros((padded - n, 3), dtype=jnp.int32)
+        pad = pad.at[:, 0].set(-1)
+        packed = jnp.concatenate([packed, pad])
+    ids = packed[:, 0].reshape(g, TRIPLE_TILE)
+    idx = (
+        jnp.clip(packed[:, 1], -bucket_limit, bucket_limit) + bucket_limit
+    ).reshape(g, TRIPLE_TILE)
+    weights = packed[:, 2].reshape(g, TRIPLE_TILE)
+    num_metrics, num_buckets = acc.shape
+
+    smem_spec = pl.BlockSpec(
+        (1, TRIPLE_TILE), lambda i: (i, 0), memory_space=pltpu.SMEM
+    )
+    return pl.pallas_call(
+        functools.partial(_pallas_kernel, num_metrics=num_metrics),
+        grid=(g,),
+        in_specs=[
+            smem_spec,
+            smem_spec,
+            smem_spec,
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct(acc.shape, acc.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, num_buckets), jnp.int32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        input_output_aliases={3: 0},
+        interpret=not _on_tpu(),
+    )(ids, idx, weights, acc)
+
+
+def make_sparse_ingest_fn(bucket_limit: int, kernel: str = "auto"):
+    """Jitted, donated-accumulator sparse merge step:
+    ``f(acc, packed) -> acc`` with acc int32 [M, B] and packed int32
+    [n, 3].  ``kernel`` picks the tier ("auto" follows the
+    capture-overridable ops.dispatch.SPARSE_KERNEL switch)."""
+    from loghisto_tpu.ops.dispatch import resolve_sparse_kernel
+
+    kernel = resolve_sparse_kernel(kernel)
+    step = (
+        pallas_sparse_ingest if kernel == "pallas" else sparse_ingest_batch
+    )
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def ingest(acc, packed):
+        return step(acc, packed, bucket_limit)
+
+    return ingest

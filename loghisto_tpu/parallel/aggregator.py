@@ -23,6 +23,7 @@ reference (a single-process Go library) does not have.
 
 from __future__ import annotations
 
+import collections
 import functools
 import threading
 import time
@@ -55,6 +56,97 @@ DEFAULT_GROWTH_FACTOR = 8
 # flushes): one compiled executable serves every merge, and a 10k-metric
 # interval is a handful of launches instead of the round-1 hundreds.
 _MERGE_CHUNK = 1 << 16
+
+# Minimum raw-item size the transport="auto" density probe runs on: the
+# unique-cell ratio of a small batch says nothing about skew, and the
+# probe itself (one host compress + unique over this prefix) must stay
+# negligible next to shipping the batch.
+_PROBE_SAMPLES = 1 << 16
+
+
+class IngestStagingRing:
+    """Depth-K reusable host staging slots for the transfer worker — the
+    CellStagingRing idea (ops/commit.py) generalized to the raw
+    (ids, values) wire.
+
+    ``stage()`` copies a chunk into the next slot, pads the tail with id
+    -1 (every ingest kernel drops it), and issues the async
+    ``device_put`` — which returns before the H2D copy completes, so the
+    upload of slot i overlaps the donated ingest dispatches still
+    consuming slot i-1.  Before a slot is REUSED (depth stages later)
+    its previous device arrays are ``block_until_ready``'d: a ready
+    device array means its H2D copy has finished reading the host
+    buffer, so overwriting the slot can never corrupt an in-flight
+    transfer.  Depth 2 is the minimum for overlap; 3 keeps one slot
+    filling, one in flight, one being consumed."""
+
+    def __init__(self, slot_samples: int, depth: int = 3,
+                 chunk_samples: Optional[int] = None):
+        if depth < 2:
+            raise ValueError(f"ring depth must be >= 2, got {depth}")
+        if slot_samples < 1:
+            raise ValueError(f"slot_samples must be >= 1, got {slot_samples}")
+        self.slot_samples = int(slot_samples)
+        # upload quantum: a partially-filled slot uploads only its prefix
+        # rounded up to this (the dispatch loop consumes chunk_samples
+        # slices) — a 1-batch item must not pay the full 8-batch slot on
+        # the wire.  Default = whole slot.
+        self.chunk_samples = int(chunk_samples or slot_samples)
+        if not 1 <= self.chunk_samples <= self.slot_samples:
+            raise ValueError(
+                f"chunk_samples must be in [1, {self.slot_samples}]; "
+                f"got {self.chunk_samples}"
+            )
+        self.depth = int(depth)
+        self._ids = [
+            np.full(self.slot_samples, -1, dtype=np.int32)
+            for _ in range(depth)
+        ]
+        self._values = [
+            np.zeros(self.slot_samples, dtype=np.float32)
+            for _ in range(depth)
+        ]
+        self._inflight: list[Optional[tuple]] = [None] * depth
+        self._next = 0
+        self.uploads = 0
+        self.bytes_uploaded = 0
+
+    def stage(self, ids: np.ndarray, values: np.ndarray):
+        """Copy one chunk (<= slot_samples) into the next slot and start
+        its async upload; returns the (ids, values) device arrays."""
+        n = len(ids)
+        if n > self.slot_samples:
+            raise ValueError(f"chunk of {n} exceeds slot {self.slot_samples}")
+        i = self._next
+        self._next = (i + 1) % self.depth
+        prev = self._inflight[i]
+        if prev is not None:
+            self._inflight[i] = None
+            for arr in prev:
+                try:
+                    arr.block_until_ready()
+                except Exception:
+                    # the old transfer errored — its batch was already
+                    # requeued/shed by the failure path; the slot is free
+                    pass
+        slot_ids, slot_values = self._ids[i], self._values[i]
+        slot_ids[:n] = ids
+        slot_values[:n] = values
+        chunk = self.chunk_samples
+        padded = min(self.slot_samples, -(-n // chunk) * chunk)
+        if n < padded:
+            slot_ids[n:padded] = -1
+            slot_values[n:padded] = 0.0
+        # contiguous prefix view: only the chunk-rounded fill crosses the
+        # wire, not the whole slot
+        ids_dev = jax.device_put(slot_ids[:padded])
+        values_dev = jax.device_put(slot_values[:padded])
+        self._inflight[i] = (ids_dev, values_dev)
+        self.uploads += 1
+        self.bytes_uploaded += padded * (
+            slot_ids.itemsize + slot_values.itemsize
+        )
+        return ids_dev, values_dev
 
 
 def local_histogram_fold(
@@ -382,11 +474,20 @@ class TPUAggregator:
             orders of magnitude less.  This is the same
             local-aggregate-before-network design as the multi-host psum
             merge, applied to the host->device hop.
-          * "auto"   — (default) "preagg" when the native library is
-            available AND the device is a real accelerator (there is a
-            wire to save); "raw" on CPU, where the "transfer" is a local
-            copy and host dedup work is pure overhead (measured: raw
-            ~53M/s vs preagg ~12M/s host-fed on a 1-core CPU)."""
+          * "sparse" — ship raw staging unchanged, but fold each FLUSH
+            on host (parallel native tier, NumPy fallback) into packed
+            (id, bucket, count) triples and merge them with the weighted
+            scatter — the raw transport's zero record-time cost with the
+            preagg transport's O(unique cells) wire.  The fold runs on
+            the transfer worker thread, overlapped with device work.
+          * "auto"   — (default) start on "raw"; the transfer worker
+            probes the first large batch's unique-cell density and
+            switches to "sparse" when the load is skewed enough to pay
+            for the fold (ops/dispatch.py SPARSE_DENSITY_CROSSOVER,
+            capture-overridable).  "preagg" is never auto-picked: its
+            record-time fold taxes producer threads, which only wins
+            when producers aren't the bottleneck — a property no
+            flush-side probe can observe."""
         self.config = config
         self.num_metrics = num_metrics
         # explicit None check: an empty registry is falsy (it has __len__),
@@ -430,6 +531,31 @@ class TPUAggregator:
 
         self._native_buf = None
         self._native_staged = 0
+        # Worker-side re-buffer for batches a device failure (or the
+        # retry cooldown) bounced back: appended chronologically by the
+        # single FIFO transfer worker, so everything here is OLDER than
+        # everything in _pending_* — flush drains requeue-first and the
+        # oldest-first shed policy stays honest.  Guarded by _lock.
+        self._requeue_ids: list[np.ndarray] = []
+        self._requeue_values: list[np.ndarray] = []
+        self._requeue_count = 0
+        # Transfer pipeline (r6 tentpole): flush() is enqueue-only; this
+        # FIFO + condition pair feeds a single transfer worker thread
+        # that stages slots, issues async device_puts, and runs the
+        # donated dispatches — so producers never block on device work,
+        # and the upload of chunk k+1 overlaps the dispatch of chunk k.
+        self._xfer_cv = threading.Condition()
+        self._xfer_queue: collections.deque = collections.deque()
+        self._xfer_queued_samples = 0  # samples sitting in the queue
+        self._xfer_active = False  # worker is mid-item
+        self._xfer_thread: Optional[threading.Thread] = None
+        self._xfer_stop = False
+        self._staging_ring: Optional[IngestStagingRing] = None
+        self.staging_depth = 3
+        # wire accounting for bytes/sample reporting (bench satellite)
+        self._xfer_uploads = 0
+        self._xfer_bytes = 0
+        self._xfer_samples_shipped = 0
         # host-side retry buffer bound when the device is unreachable
         self.max_pending_samples = 32 * batch_size
         self.retry_cooldown = 1.0  # seconds between device retry attempts
@@ -505,32 +631,21 @@ class TPUAggregator:
                     "Python staging", _native.build_error(),
                 )
 
-        if transport not in ("auto", "raw", "preagg"):
+        if transport not in ("auto", "raw", "preagg", "sparse"):
             raise ValueError(
-                f"transport={transport!r}: expected 'auto', 'raw', or "
-                "'preagg'"
+                f"transport={transport!r}: expected 'auto', 'raw', "
+                "'preagg', or 'sparse'"
             )
+        # "auto" (r6): start on raw and let the transfer worker probe
+        # the first large batch's cell density — skewed load switches to
+        # the sparse transport at runtime (ops.dispatch.choose_transport
+        # / SPARSE_DENSITY_CROSSOVER).  "preagg" stays an explicit
+        # opt-in: its record-time fold trades producer-thread CPU for
+        # flush latency, a workload property no flush-side probe sees.
+        self._transport_auto = transport == "auto"
+        self.probe_density: Optional[float] = None
         if transport == "auto":
-            from loghisto_tpu import _native
-
-            platform = (
-                mesh.devices.flat[0].platform
-                if mesh is not None
-                else jax.default_backend()
-            )
-            transport = (
-                "preagg"
-                if platform != "cpu" and _native.available()
-                else "raw"
-            )
-        elif transport == "preagg":
-            from loghisto_tpu import _native
-
-            if not _native.available():
-                raise RuntimeError(
-                    f"transport='preagg' needs the native library: "
-                    f"{_native.build_error()}"
-                )
+            transport = "raw"
         self.transport = transport
         self._cell_store = None
         # watermark: ship cells to the device mid-interval once the host
@@ -543,9 +658,11 @@ class TPUAggregator:
             # fold into per-thread shards at record time (the C fold runs
             # with the GIL released, so writer threads aggregate in
             # parallel), and draining swaps buffers per shard so the
-            # O(capacity) scan never blocks ingest.
+            # O(capacity) scan never blocks ingest.  backend="auto"
+            # degrades to the pure-NumPy store when no compiler built the
+            # native library — preagg no longer requires one (r6).
             self._cell_store = _nat.ShardedCellStore(
-                config.bucket_limit, config.precision
+                config.bucket_limit, config.precision, backend="auto"
             )
             if self._native_buf is not None:
                 import logging
@@ -644,11 +761,14 @@ class TPUAggregator:
             )
         self.ingest_path = ingest_path
         self._weighted_ingest = make_weighted_ingest_fn(config.bucket_limit)
-        if self._cell_store is not None:
-            from loghisto_tpu.ops.ingest import make_packed_ingest_fn
+        # Packed [n, 3] merge step — built unconditionally (not just for
+        # preagg) because transport="auto" can switch to sparse at
+        # runtime after the density probe; the kernel tier follows the
+        # capture-overridable SPARSE_KERNEL switch.  Compilation is lazy
+        # (first packed merge), so raw-only aggregators never pay for it.
+        from loghisto_tpu.ops.sparse_ingest import make_sparse_ingest_fn
 
-            # preagg wire format: one int32 [n, 3] array per merge chunk
-            self._packed_ingest = make_packed_ingest_fn(config.bucket_limit)
+        self._packed_ingest = make_sparse_ingest_fn(config.bucket_limit)
         self._stats_fn = jax.jit(
             functools.partial(
                 dense_stats,
@@ -871,40 +991,67 @@ class TPUAggregator:
         failure would permanently break ingestion)."""
         return self._make_acc()
 
+    def _buffered_samples(self) -> int:
+        """Samples currently buffered on host awaiting a device attempt
+        (requeued failures + fresh pending).  Unsynchronized sum — a
+        monitoring/test convenience, exact whenever the transfer queue
+        is idle."""
+        return self._requeue_count + self._pending_count
+
     def _bound_pending_locked(self) -> None:
-        """Enforce max_pending_samples by shedding the OLDEST samples,
-        slicing partial arrays so no more than the overflow is dropped.
-        Caller holds self._lock."""
-        overflow = self._pending_count - self.max_pending_samples
-        while overflow > 0 and self._pending_ids:
-            head = self._pending_ids[0]
-            if len(head) <= overflow:
-                self._pending_ids.pop(0)
-                self._pending_values.pop(0)
-                self._pending_count -= len(head)
-                with self._shed_lock:
-                    self._shed_samples += len(head)
-                overflow -= len(head)
-            else:
-                self._pending_ids[0] = head[overflow:]
-                self._pending_values[0] = self._pending_values[0][overflow:]
-                self._pending_count -= overflow
-                with self._shed_lock:
-                    self._shed_samples += overflow
-                overflow = 0
+        """Enforce max_pending_samples over the WHOLE host buffer
+        (requeue + pending) by shedding the OLDEST samples — the requeue
+        lists hold strictly older content than _pending (single FIFO
+        worker), so they shed first.  Partial arrays are sliced so no
+        more than the overflow is dropped.  Caller holds self._lock."""
+        overflow = (
+            self._requeue_count + self._pending_count
+            - self.max_pending_samples
+        )
+        for ids_list, values_list, count_attr in (
+            (self._requeue_ids, self._requeue_values, "_requeue_count"),
+            (self._pending_ids, self._pending_values, "_pending_count"),
+        ):
+            while overflow > 0 and ids_list:
+                head = ids_list[0]
+                if len(head) <= overflow:
+                    ids_list.pop(0)
+                    values_list.pop(0)
+                    setattr(
+                        self, count_attr,
+                        getattr(self, count_attr) - len(head),
+                    )
+                    with self._shed_lock:
+                        self._shed_samples += len(head)
+                    overflow -= len(head)
+                else:
+                    ids_list[0] = head[overflow:]
+                    values_list[0] = values_list[0][overflow:]
+                    setattr(
+                        self, count_attr,
+                        getattr(self, count_attr) - overflow,
+                    )
+                    with self._shed_lock:
+                        self._shed_samples += overflow
+                    overflow = 0
 
     def flush(self, force: bool = False) -> None:
-        """Push buffered samples to the device accumulator.
+        """Hand buffered samples to the transfer pipeline.
 
-        Batches are shipped in fixed-size chunks (padding the tail with
-        id -1, which the kernel drops) so the jitted ingest compiles for
-        exactly one shape instead of one executable per batch length.
+        flush() is ENQUEUE-ONLY (r6 tentpole): it drains host staging
+        under _lock, enqueues one transfer item, and returns — the
+        transfer worker thread stages ring slots, issues the async
+        device_puts, and runs the donated dispatches, so producers never
+        block on device work and the upload of chunk k+1 overlaps the
+        dispatch of chunk k.  ``force=True`` (collect / checkpoint /
+        close) additionally WAITS until the whole queue has drained —
+        after a forced flush, device state reflects every prior record.
 
-        Device failures follow SURVEY.md §5.3 shed-don't-block: samples
-        buffer on host (bounded, oldest shed first) and retries are
-        cooldown-gated so a down device costs one attempt per
-        retry_cooldown, not one per record.  `force=True` (used by
-        collect()) bypasses the cooldown."""
+        Device failures follow SURVEY.md §5.3 shed-don't-block: the
+        worker re-buffers the unapplied remainder on host (bounded,
+        oldest shed first) and retries are cooldown-gated so a down
+        device costs one attempt per retry_cooldown, not one per
+        record."""
         if self._cell_store is not None:
             # preagg: samples were folded at record time; flushing means
             # shipping the deduped cells.  Mid-interval ships happen only
@@ -912,7 +1059,11 @@ class TPUAggregator:
             # cells once); `force` (collect/checkpoint) always ships.
             if not force and len(self._cell_store) < self.max_host_cells:
                 return
-            self._ship_packed(self._cell_store.drain_packed_all())
+            packed = self._cell_store.drain_packed_all()
+            if len(packed):
+                self._enqueue_xfer(("packed", packed, None, 0, force))
+            if force:
+                self.wait_transfers()
             return
         if self._native_buf is not None:
             with self._lock:
@@ -925,7 +1076,7 @@ class TPUAggregator:
                     self._pending_count += len(nids)
                     self._bound_pending_locked()
         with self._lock:
-            if not self._pending_count:
+            if not self._requeue_count and not self._pending_count:
                 ids = values = None
             elif (
                 not force
@@ -935,39 +1086,257 @@ class TPUAggregator:
                 # is a benign race (cooldown is a heuristic, not an
                 # invariant)
                 return  # device cooling down; keep buffering
+            elif (
+                not force
+                and self._xfer_queued_samples >= self.max_pending_samples
+            ):
+                # transfer queue saturated (device slower than producers):
+                # leave samples in the bounded host buffer, where the
+                # oldest-first shed policy applies, instead of growing
+                # the queue without bound
+                return
             else:
-                ids = np.concatenate(self._pending_ids)
-                values = np.concatenate(self._pending_values)
+                # requeue first: strictly older than anything in _pending
+                ids = np.concatenate(self._requeue_ids + self._pending_ids)
+                values = np.concatenate(
+                    self._requeue_values + self._pending_values
+                )
+                self._requeue_ids, self._requeue_values = [], []
+                self._requeue_count = 0
                 self._pending_ids, self._pending_values = [], []
                 self._pending_count = 0
-        # staging lock released: producers keep appending while the device
-        # loop below runs (non-blocking flush, SURVEY.md §7 hard part (a))
-        if ids is None:
+        if ids is not None:
+            kind = "fold" if self.transport == "sparse" else "raw"
+            self._enqueue_xfer((kind, ids, values, len(ids), force))
+        if not force:
             return
-        n = len(ids)
-        bs = self.batch_size
-        padded = (n + bs - 1) // bs * bs
-        if padded != n:
-            ids = np.concatenate(
-                [ids, np.full(padded - n, -1, dtype=np.int32)]
-            )
+        self.wait_transfers()
+        # An item already in flight when we drained may have failed
+        # DURING the wait and requeued its samples — invisible to the
+        # drain above, yet recorded strictly before this flush, so the
+        # forced barrier owes them one forced (cooldown-bypassing)
+        # attempt, exactly as the synchronous flush gave them.  One extra
+        # pass only: if that attempt also fails, the device is down and
+        # the samples stay buffered (same bounded-attempts contract as
+        # the worker path).
+        with self._lock:
+            if not self._requeue_count and not self._pending_count:
+                return
+            ids = np.concatenate(self._requeue_ids + self._pending_ids)
             values = np.concatenate(
-                [values, np.zeros(padded - n, dtype=np.float32)]
+                self._requeue_values + self._pending_values
             )
-        # Transfer in super-chunks of 8 ingest batches: ONE async H2D per
-        # super-chunk (device_put returns before the copy completes, so
-        # the transfer of super-chunk S+1 overlaps the ingest dispatches
-        # of S), per-chunk slicing happens ON DEVICE, and the staging
-        # footprint on device is bounded at 8*batch_size entries even
-        # when a force-flush drains a 32*batch_size host backlog.
-        super_bs = 8 * bs
+            self._requeue_ids, self._requeue_values = [], []
+            self._requeue_count = 0
+            self._pending_ids, self._pending_values = [], []
+            self._pending_count = 0
+        kind = "fold" if self.transport == "sparse" else "raw"
+        self._enqueue_xfer((kind, ids, values, len(ids), True))
+        self.wait_transfers()
+
+    # -- transfer pipeline ---------------------------------------------- #
+
+    def _enqueue_xfer(self, item: tuple) -> None:
+        """Append one (kind, a, b, n_samples, force) item to the transfer
+        queue, lazily (re)spawning the worker thread."""
+        with self._xfer_cv:
+            if self._xfer_thread is None or not self._xfer_thread.is_alive():
+                self._xfer_stop = False
+                self._xfer_thread = threading.Thread(
+                    target=self._xfer_worker,
+                    daemon=True,
+                    name="loghisto-tpu-xfer",
+                )
+                self._xfer_thread.start()
+            self._xfer_queue.append(item)
+            self._xfer_queued_samples += item[3]
+            self._xfer_cv.notify_all()
+
+    def wait_transfers(self, timeout: Optional[float] = None) -> bool:
+        """Block until the transfer queue is empty AND the worker is idle
+        (every enqueued flush has reached the device, the spill, or the
+        requeue buffer).  The synchronization barrier behind
+        flush(force=True); tests and checkpointing rely on it.  Returns
+        False on timeout."""
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        with self._xfer_cv:
+            while self._xfer_queue or self._xfer_active:
+                remaining = (
+                    None if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._xfer_cv.wait(remaining)
+        return True
+
+    def close(self) -> None:
+        """Drain everything and stop the transfer worker.  flush(force)
+        first fully drains the staging ring and queue (exact count
+        conservation — nothing in flight is dropped), then the worker is
+        signalled down and joined.  The aggregator stays usable: a later
+        flush lazily re-spawns the worker."""
+        self.flush(force=True)
+        with self._xfer_cv:
+            self._xfer_stop = True
+            self._xfer_cv.notify_all()
+            t = self._xfer_thread
+        if t is not None:
+            t.join(timeout=10.0)
+
+    def _xfer_worker(self) -> None:
+        while True:
+            with self._xfer_cv:
+                while not self._xfer_queue and not self._xfer_stop:
+                    self._xfer_cv.wait()
+                if not self._xfer_queue:  # stop requested, queue drained
+                    self._xfer_active = False
+                    self._xfer_cv.notify_all()
+                    return
+                item = self._xfer_queue.popleft()
+                self._xfer_active = True
+            try:
+                self._process_xfer_item(item)
+            except Exception:  # pragma: no cover - defensive
+                import logging
+
+                logging.getLogger("loghisto_tpu").exception(
+                    "transfer worker failed processing a %s item", item[0]
+                )
+            finally:
+                with self._xfer_cv:
+                    self._xfer_queued_samples -= item[3]
+                    self._xfer_active = False
+                    self._xfer_cv.notify_all()
+
+    def _process_xfer_item(self, item: tuple) -> None:
+        kind, a, b, n, force = item
+        if kind == "packed":
+            self._xfer_uploads += 1
+            self._xfer_bytes += a.nbytes
+            self._xfer_samples_shipped += int(a[:, 2].sum(dtype=np.int64))
+            self._ship_packed(a)
+            return
+        # raw staging content ("raw" ships samples, "fold" packs first).
+        # Cooldown gate runs HERE, per item: after a failure arms the
+        # cooldown, queued non-forced items bounce straight to the
+        # requeue buffer without a device attempt — one attempt per
+        # cooldown window, in arrival order.
+        if not force and time.monotonic() < self._device_down_until:
+            self._requeue_raw(a, b)
+            return
+        if kind == "fold" or self._maybe_switch_sparse(a, b, n):
+            self._process_fold(a, b, n)
+            return
+        self._process_raw(a, b, n)
+
+    def _requeue_raw(self, ids: np.ndarray, values: np.ndarray) -> None:
+        if not len(ids):
+            return
+        with self._lock:
+            self._requeue_ids.append(ids)
+            self._requeue_values.append(values)
+            self._requeue_count += len(ids)
+            self._bound_pending_locked()
+
+    def _maybe_switch_sparse(
+        self, ids: np.ndarray, values: np.ndarray, n: int
+    ) -> bool:
+        """transport="auto" density probe (runs once, on the worker, on
+        the first raw item large enough to be representative): measure
+        unique-cell density on a _PROBE_SAMPLES prefix with the host
+        codec, and switch to the sparse transport when the load is
+        skewed past the crossover.  Returns True when THIS item should
+        already take the fold path."""
+        if not self._transport_auto or self.probe_density is not None:
+            return False
+        if n < _PROBE_SAMPLES:
+            return False
+        from loghisto_tpu import _native
+        from loghisto_tpu.ops import dispatch as _dispatch
+
+        m = _PROBE_SAMPLES
+        buckets = _native.compress_np_host(
+            values[:m], self.config.precision
+        ).astype(np.int64)
+        keep = ids[:m] >= 0
+        kept = int(keep.sum())
+        if not kept:
+            return False
+        keys = (ids[:m][keep].astype(np.int64) << 16) | (
+            buckets[keep] + 32768
+        )
+        self.probe_density = len(np.unique(keys)) / kept
+        platform = (
+            self.mesh.devices.flat[0].platform
+            if self.mesh is not None
+            else jax.default_backend()
+        )
+        chosen = _dispatch.choose_transport(
+            platform, density=self.probe_density
+        )
+        if chosen != self.transport:
+            import logging
+
+            logging.getLogger("loghisto_tpu").info(
+                "transport auto-probe: cell density %.3f <= crossover "
+                "%.3f; switching to the sparse packed-triple transport",
+                self.probe_density, _dispatch.SPARSE_DENSITY_CROSSOVER,
+            )
+            self.transport = chosen
+        return self.transport == "sparse"
+
+    def _process_fold(
+        self, ids: np.ndarray, values: np.ndarray, n: int
+    ) -> None:
+        """Sparse transport: fold the raw batch into packed triples on
+        this worker thread (GIL-released parallel native tier, NumPy
+        fallback) and merge them via the packed scatter.  Failures past
+        this point spill exactly (cells are finished aggregates — no
+        retry queue needed)."""
+        from loghisto_tpu import _native
+
+        try:
+            packed = _native.fold_packed(
+                ids, values,
+                bucket_limit=self.config.bucket_limit,
+                precision=self.config.precision,
+            )
+        except MemoryError:
+            # can't build the fold table: ship the batch raw instead of
+            # losing it (same wire contract, just more bytes)
+            self._process_raw(ids, values, n)
+            return
+        self._xfer_uploads += 1
+        self._xfer_bytes += packed.nbytes
+        self._xfer_samples_shipped += n
+        self._ship_packed(packed)
+
+    def _process_raw(
+        self, ids: np.ndarray, values: np.ndarray, n: int
+    ) -> None:
+        """Raw transport device loop (worker thread): stage super-chunks
+        through the reusable ring (async upload overlapping the previous
+        slot's dispatches), dispatch per batch_size chunk under
+        _dev_lock with the per-chunk spill check, and requeue the
+        unapplied remainder on failure."""
+        bs = self.batch_size
+        ring = self._staging_ring
+        if ring is None or ring.slot_samples != 8 * bs:
+            ring = self._staging_ring = IngestStagingRing(
+                8 * bs, depth=self.staging_depth, chunk_samples=bs
+            )
+        super_bs = ring.slot_samples
         retry_off = None
         with self._dev_lock:
-            for soff in range(0, padded, super_bs):
-                send = min(soff + super_bs, padded)
+            for soff in range(0, n, super_bs):
+                send = min(soff + super_bs, n)
                 try:
-                    ids_dev = jax.device_put(ids[soff:send])
-                    values_dev = jax.device_put(values[soff:send])
+                    ids_dev, values_dev = ring.stage(
+                        ids[soff:send], values[soff:send]
+                    )
                 except Exception:
                     retry_off = soff
                     self._on_device_failure_locked()
@@ -995,6 +1364,9 @@ class TPUAggregator:
                         break
                 if retry_off is not None:
                     break
+        self._xfer_samples_shipped += (
+            n if retry_off is None else retry_off
+        )
         if retry_off is not None and retry_off < n:
             import logging
 
@@ -1004,16 +1376,23 @@ class TPUAggregator:
                 "buffering %d samples for retry (cooldown %.1fs)",
                 n - retry_off, self.retry_cooldown,
             )
-            with self._lock:
-                # PREPEND: producers kept appending while the device loop
-                # ran unlocked, so these drained samples are older than
-                # anything now in _pending — front insertion keeps the
-                # buffer chronological and _bound_pending_locked's
-                # shed-the-OLDEST policy honest
-                self._pending_ids.insert(0, ids[retry_off:n])
-                self._pending_values.insert(0, values[retry_off:n])
-                self._pending_count += n - retry_off
-                self._bound_pending_locked()
+            self._requeue_raw(ids[retry_off:n], values[retry_off:n])
+
+    def transport_stats(self) -> dict:
+        """Wire accounting for the active transport: uploads, bytes
+        actually moved host->device (ring slots count their padded
+        size — that IS what transfers), and samples those bytes carried.
+        bench.py / benchmarks/h2d_bench.py derive bytes/sample from
+        this."""
+        ring = self._staging_ring
+        return {
+            "transport": self.transport,
+            "probe_density": self.probe_density,
+            "uploads": self._xfer_uploads + (ring.uploads if ring else 0),
+            "bytes_uploaded": self._xfer_bytes
+            + (ring.bytes_uploaded if ring else 0),
+            "samples_shipped": self._xfer_samples_shipped,
+        }
 
     def _preagg_record(self, ids: np.ndarray, values: np.ndarray) -> None:
         """Fold one batch into the calling thread's cell shard (the preagg

@@ -50,6 +50,7 @@ class TPUMetricSystem(MetricSystem):
         retention=None,
         commit: str = "auto",
         lifecycle=None,
+        transport: str = "auto",
     ):
         """``retention`` turns on the windowed retention tier:
         ``True`` builds a TimeWheel with the default 60x1 / 60x60 /
@@ -77,7 +78,11 @@ class TPUMetricSystem(MetricSystem):
         periodically compacted, and a ``lifecycle.*`` gauge family
         reports the churn.  Requires retention + the fused commit path
         (the subsystem's clock and activity signal ARE the committed
-        intervals)."""
+        intervals).
+
+        ``transport`` passes through to the TPUAggregator's host->device
+        transport selection ("auto" / "raw" / "preagg" / "sparse"; see
+        TPUAggregator.__init__)."""
         super().__init__(
             interval=interval, sys_stats=sys_stats, config=config,
             fast_ingest=fast_ingest,
@@ -88,6 +93,7 @@ class TPUMetricSystem(MetricSystem):
             percentiles=percentiles,
             mesh=mesh,
             native_staging=native_staging,
+            transport=transport,
         )
         self.aggregator.register_device_gauges(self)
 
@@ -273,4 +279,8 @@ class TPUMetricSystem(MetricSystem):
             self.aggregator.detach()
             if self.retention is not None:
                 self.retention.detach()
+        # drain the transfer pipeline fully (staging ring + queue) so a
+        # shutdown never strands in-flight samples; the worker re-spawns
+        # lazily if start() resumes ingestion
+        self.aggregator.close()
         super().stop()

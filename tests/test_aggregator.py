@@ -476,7 +476,9 @@ def test_preagg_watermark_ships_mid_interval():
     # and the watermark forces a device ship despite force=False
     vals = (np.arange(64) * 7 + 1).astype(np.float32)
     agg.record_batch(np.zeros(64, dtype=np.int32), vals)
-    assert len(agg._cell_store) == 0  # shipped
+    assert len(agg._cell_store) == 0  # drained for shipping
+    # the ship rides the transfer worker now; barrier before inspecting
+    assert agg.wait_transfers(timeout=30.0)
     assert np.asarray(agg._acc).sum() == 64
     assert agg.collect().metrics["m_count"] == 64
 
@@ -560,6 +562,7 @@ def test_growth_and_spill_together_under_mesh():
         ids = rng.integers(0, 4, 64).astype(np.int32)
         expected[:4] += np.bincount(ids, minlength=4)[:4]
         agg.record_batch(ids, rng.lognormal(2, 1, 64).astype(np.float32))
+    assert agg.wait_transfers(timeout=30.0)  # flushes ride the worker now
     assert agg._spill is not None, "spill never engaged"
     assert agg._spill.shape[0] == 4
 

@@ -16,6 +16,8 @@ def restore_dispatch_globals():
         dispatch.SORT_MIN_METRICS,
         dispatch.PALLAS_SINGLE_METRIC,
         dispatch.HIGH_CARDINALITY_KERNEL,
+        dispatch.SPARSE_DENSITY_CROSSOVER,
+        dispatch.SPARSE_KERNEL,
         dispatch.THRESHOLDS_FILE,
         dispatch.THRESHOLDS_SOURCE,
     )
@@ -24,6 +26,8 @@ def restore_dispatch_globals():
         dispatch.SORT_MIN_METRICS,
         dispatch.PALLAS_SINGLE_METRIC,
         dispatch.HIGH_CARDINALITY_KERNEL,
+        dispatch.SPARSE_DENSITY_CROSSOVER,
+        dispatch.SPARSE_KERNEL,
         dispatch.THRESHOLDS_FILE,
         dispatch.THRESHOLDS_SOURCE,
     ) = saved
@@ -79,6 +83,74 @@ def test_malformed_or_missing_thresholds_file_is_ignored(
     dispatch._load_thresholds()
     assert (dispatch.SORT_MIN_METRICS, dispatch.PALLAS_SINGLE_METRIC,
             dispatch.HIGH_CARDINALITY_KERNEL) == before
+
+
+def test_transport_crossover_overrides(tmp_path, restore_dispatch_globals):
+    """The r6 transport entries ride the same committed-JSON machinery:
+    sparse_density_crossover retunes choose_transport, sparse_kernel
+    retunes resolve_sparse_kernel."""
+    table = {
+        "source": "TPU_CAPTURE_test",
+        "sparse_density_crossover": 0.25,
+        "sparse_kernel": "pallas",
+    }
+    path = tmp_path / "dispatch_thresholds.json"
+    path.write_text(json.dumps(table))
+    dispatch.THRESHOLDS_FILE = str(path)
+    dispatch._load_thresholds()
+    assert dispatch.SPARSE_DENSITY_CROSSOVER == 0.25
+    assert dispatch.SPARSE_KERNEL == "pallas"
+    assert dispatch.THRESHOLDS_SOURCE == "TPU_CAPTURE_test"
+    # the policy reflects the override immediately
+    assert dispatch.choose_transport("cpu", density=0.2) == "sparse"
+    assert dispatch.choose_transport("cpu", density=0.3) == "raw"
+    assert dispatch.resolve_sparse_kernel("auto") == "pallas"
+
+
+def test_transport_crossover_garbage_degrades_to_raw(
+    tmp_path, restore_dispatch_globals
+):
+    """A missing or garbage thresholds file must never crash transport
+    selection — the baked crossover stands and undecided (no-probe)
+    batches ship raw."""
+    before = (dispatch.SPARSE_DENSITY_CROSSOVER, dispatch.SPARSE_KERNEL)
+    dispatch.THRESHOLDS_FILE = str(tmp_path / "missing.json")
+    dispatch._load_thresholds()
+    for garbage in (
+        "{not json",
+        json.dumps({"sparse_density_crossover": "half",
+                    "sparse_kernel": "quantum"}),
+        json.dumps({"sparse_density_crossover": 7.5}),   # out of [0, 1]
+        json.dumps({"sparse_density_crossover": True}),  # bool is not a ratio
+        json.dumps([1, 2, 3]),
+    ):
+        bad = tmp_path / "bad.json"
+        bad.write_text(garbage)
+        dispatch.THRESHOLDS_FILE = str(bad)
+        dispatch._load_thresholds()
+        assert (
+            dispatch.SPARSE_DENSITY_CROSSOVER, dispatch.SPARSE_KERNEL
+        ) == before
+        # selection still works and defaults conservatively
+        assert dispatch.choose_transport("cpu") == "raw"
+        assert dispatch.choose_transport("tpu", density=None) == "raw"
+        assert dispatch.resolve_sparse_kernel("auto") == "jnp"
+
+
+def test_choose_transport_policy():
+    # no probe yet -> raw (zero host-fold risk); skewed probe -> sparse;
+    # dense probe -> raw; preagg never auto-picked at any density
+    assert dispatch.choose_transport("tpu") == "raw"
+    crossover = dispatch.SPARSE_DENSITY_CROSSOVER
+    assert dispatch.choose_transport("tpu", density=crossover) == "sparse"
+    assert dispatch.choose_transport(
+        "tpu", density=min(1.0, crossover + 0.01)
+    ) == "raw"
+    assert dispatch.choose_transport("cpu", density=0.0) == "sparse"
+    assert dispatch.choose_transport("tpu", density=0.0, native_ok=False) \
+        == "raw"
+    with pytest.raises(ValueError):
+        dispatch.resolve_sparse_kernel("quantum")
 
 
 def _derive(winners_table):

@@ -1,6 +1,11 @@
 """Failure injection: device ingest failures must buffer-and-retry on
 host with bounded memory (SURVEY.md §5.3), never block or lose silently
-within the bound."""
+within the bound.
+
+flush() is enqueue-only (r6 transfer pipeline): device attempts happen
+on the transfer worker, so these tests call wait_transfers() before
+inspecting failure-path state, and buffered samples live in the
+requeue+pending pair (_buffered_samples())."""
 
 import numpy as np
 import pytest
@@ -38,8 +43,11 @@ def test_device_failure_buffers_and_retries():
         np.zeros(100, dtype=np.int32), np.full(100, 5.0, dtype=np.float32)
     )
     agg.flush()  # fails; samples buffered
-    assert agg._pending_count > 0
+    assert agg.wait_transfers(timeout=30.0)
+    assert agg._buffered_samples() > 0
     agg.flush()  # fails again; still buffered
+    assert agg.wait_transfers(timeout=30.0)
+    assert agg._buffered_samples() > 0
     out = agg.collect().metrics  # collect's flush succeeds (3rd call)
     assert out["m_count"] == 100  # nothing lost within the bound
     assert agg._shed_samples == 0
@@ -55,9 +63,12 @@ def test_device_failure_cooldown_gates_retries():
         agg.record_batch(
             np.zeros(64, dtype=np.int32), np.full(64, 5.0, dtype=np.float32)
         )
-    # one failed attempt, then the cooldown swallows the rest
+    assert agg.wait_transfers(timeout=30.0)
+    # one failed attempt, then the cooldown swallows the rest — whether a
+    # flush was gated producer-side (flush returns early) or worker-side
+    # (queued item bounces to the requeue buffer without an attempt)
     assert flaky.calls == 1
-    assert agg._pending_count == 5 * 64  # nothing lost, all buffered
+    assert agg._buffered_samples() == 5 * 64  # nothing lost, all buffered
 
 
 def test_pad_never_enters_retry_buffer():
@@ -68,8 +79,9 @@ def test_pad_never_enters_retry_buffer():
     agg.record_batch(
         np.zeros(100, dtype=np.int32), np.full(100, 5.0, dtype=np.float32)
     )
-    agg.flush()  # fails: 100 real samples requeued, 156 pad entries not
-    assert agg._pending_count == 100
+    agg.flush()  # fails: 100 real samples requeued, ring pad entries not
+    assert agg.wait_transfers(timeout=30.0)
+    assert agg._buffered_samples() == 100
     out = agg.collect().metrics
     assert out["m_count"] == 100
 
@@ -83,8 +95,9 @@ def test_bounded_shedding_is_exact():
     agg.record_batch(
         np.zeros(256, dtype=np.int32), np.full(256, 5.0, dtype=np.float32)
     )
+    assert agg.wait_transfers(timeout=30.0)
     # bound holds exactly: only the overflow is shed, the cap is retained
-    assert agg._pending_count == 100
+    assert agg._buffered_samples() == 100
     assert agg._shed_samples == 156
 
 
@@ -98,7 +111,8 @@ def test_device_failure_sheds_beyond_bound():
         agg.record_batch(
             np.zeros(64, dtype=np.int32), np.full(64, 5.0, dtype=np.float32)
         )
-    assert agg._pending_count <= agg.max_pending_samples
+    assert agg.wait_transfers(timeout=30.0)
+    assert agg._buffered_samples() <= agg.max_pending_samples
     assert agg._shed_samples > 0  # overflow shed, loudly countable
     # accounting is exact: buffered + shed == recorded
-    assert agg._pending_count + agg._shed_samples == 10 * 64
+    assert agg._buffered_samples() + agg._shed_samples == 10 * 64
