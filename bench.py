@@ -467,6 +467,27 @@ def main() -> None:
         print(f"bench: cardinality-churn stage failed: {e}", file=sys.stderr)
     ready4.set()
 
+    # drift-engine headline at the 10k point (benchmarks/anomaly_bench.py
+    # has the 1/16/10k grid): EWMA ride-along overhead on the fused
+    # commit (zero extra dispatches) and the one divergence dispatch.
+    ready5 = _start_watchdog(300.0, on_timeout=lambda: print(
+        json.dumps(result), flush=True
+    ))
+    try:
+        from benchmarks.anomaly_bench import run as anomaly_run
+
+        a10k = anomaly_run(reps=10, configs=["10000"])["configs"]["10000"]
+        result["drift_ewma_overhead_pct"] = a10k["ewma_overhead_pct"]
+        result["drift_ewma_extra_dispatches"] = (
+            a10k["ewma_extra_dispatches"]
+        )
+        result["drift_score_p99_us"] = a10k["divergence_score"]["p99_us"]
+        result["drift_score_ns_per_row"] = a10k["divergence_ns_per_row"]
+        result["drift_score_suspect"] = a10k["suspect"]
+    except Exception as e:  # never let the extra metric kill the bench
+        print(f"bench: anomaly stage failed: {e}", file=sys.stderr)
+    ready5.set()
+
     print(json.dumps(result))
 
 

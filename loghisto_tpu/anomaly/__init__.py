@@ -1,0 +1,13 @@
+"""Distribution drift engine: EWMA baseline banks maintained inside the
+fused interval commit, one fused divergence dispatch per interval
+(KS / JSD / bucket-space EMD), and generation-keyed score serving for
+``distribution_drift`` rules and per-metric gauges.
+
+See ``ops.anomaly`` for the device programs and ``AnomalyManager`` for
+the host runtime; wired via ``TPUMetricSystem(anomaly=AnomalyConfig())``.
+"""
+
+from loghisto_tpu.anomaly.config import AnomalyConfig, hourly_bank
+from loghisto_tpu.anomaly.manager import AnomalyManager
+
+__all__ = ["AnomalyConfig", "AnomalyManager", "hourly_bank"]

@@ -1,0 +1,379 @@
+"""AnomalyManager: the runtime that owns the EWMA baseline banks, runs
+the per-interval divergence scoring, and serves drift scores to rules
+and exporters.
+
+Threading model (same as ``lifecycle.LifecycleManager``): the manager
+piggybacks on the IntervalCommitter's bridge thread.  The committer
+threads the donated carries — the interval histogram ``ihist`` and the
+baseline banks ``(prof, wsum)`` — through the fused commit programs
+(``ensure_capacity_locked`` / ``store_carry_locked``, called with the
+aggregator's ``_dev_lock`` held, like the activity vector they sit
+beside), then calls ``on_interval()`` with no locks held BEFORE the
+wheel's hooks run, so ``distribution_drift`` rules evaluate against the
+interval that just landed.  Scoring reads the wheel's published
+snapshot handle (immutable, never donated) and the bank carries, and
+runs ONE jitted dispatch (``ops.anomaly.make_divergence_fn``) — the
+drift engine's entire per-interval device cost beyond the fused commit
+the banks already ride.
+
+Score serving is generation-keyed, mirroring the query engine's dead-id
+contract: ``scores_for(name)`` resolves the name through the registry
+and returns None when the registry generation moved since the scores
+were computed (eviction, slot reuse, compaction) or the name's id has
+no scored row — a dead or reused id can never serve a stale series'
+drift score (tests/test_anomaly.py pins this).
+
+Lifecycle integration: the LifecycleManager calls
+``on_evicted_locked`` / ``apply_permutation_locked`` inside its device
+critical sections so bank rows are zeroed with their victims and follow
+their survivors through compaction; a freed row's next tenant always
+starts with a cold baseline.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import logging
+import threading
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from loghisto_tpu.anomaly.config import AnomalyConfig
+from loghisto_tpu.ops.anomaly import (
+    make_bank_compact_fn,
+    make_bank_evict_fn,
+    make_divergence_fn,
+    resolve_divergence_path,
+)
+
+logger = logging.getLogger("loghisto_tpu")
+
+SCORE_KEYS = ("ks", "jsd", "emd")
+
+
+class AnomalyManager:
+    """Drift-engine runtime for a (TPUAggregator, TimeWheel) pair.
+    Built by TPUMetricSystem when ``anomaly=AnomalyConfig(...)`` is
+    passed; standalone construction is supported for tests."""
+
+    def __init__(
+        self,
+        aggregator,
+        wheel,
+        config: AnomalyConfig,
+        metric_system=None,
+    ):
+        if wheel is None:
+            raise ValueError(
+                "the drift engine needs a retention wheel: baselines "
+                "ride the fused interval commit and scoring consumes "
+                "the commit-time snapshot CDFs"
+            )
+        if not wheel.snapshots_enabled:
+            raise ValueError(
+                "the drift engine needs commit-time snapshots "
+                "(TimeWheel snapshots=True): scoring consumes the "
+                "published window CDF views"
+            )
+        if config.tier >= len(wheel._tiers):
+            raise ValueError(
+                f"anomaly tier {config.tier} out of range "
+                f"({len(wheel._tiers)} tiers)"
+            )
+        self.aggregator = aggregator
+        self.wheel = wheel
+        self.config = config
+        self.metric_system = metric_system
+        platform = jax.default_backend()
+        self.divergence_path = resolve_divergence_path(
+            config.divergence_path, platform, aggregator.mesh is not None
+        )
+        self._div = make_divergence_fn(self.divergence_path)
+        self._evict = make_bank_evict_fn()
+        self._compact = make_bank_compact_fn()
+        if config.window is not None:
+            # materialize the scoring window as a snapshot view so each
+            # pass gathers a commit-time CDF instead of recomputing
+            wheel.pin_window(config.window)
+
+        # donated device carries, guarded by aggregator._dev_lock like
+        # the accumulator/activity vector they commit beside
+        self._prof: Optional[jnp.ndarray] = None   # f32 [K, M, B]
+        self._wsum: Optional[jnp.ndarray] = None   # f32 [K, M]
+        self._ihist: Optional[jnp.ndarray] = None  # int32 [M, B]
+
+        # latest host scores + the registry generation they were
+        # computed under (the staleness key for dead/reused ids)
+        self._scores_lock = threading.Lock()
+        self._scores: Optional[Dict[str, np.ndarray]] = None
+        self._scores_gen = -1
+        self._scores_epoch = -1
+
+        self._intervals_seen = 0
+        self.scored_intervals = 0
+        self.skipped_intervals = 0  # no snapshot / no baselines yet
+
+        # lazy per-metric gauge export (anomaly.<name>.{ks,jsd,emd})
+        self._export_key = None  # (generation, registry high-water)
+        self._exported: set = set()
+
+    # -- traced scalar operands for the fused programs ------------------- #
+
+    @property
+    def decay32(self) -> np.float32:
+        return np.float32(self.config.decay)
+
+    @property
+    def min_count32(self) -> np.int32:
+        return np.int32(self.config.min_samples)
+
+    def bank_for(self, t) -> np.int32:
+        """Active bank index for an interval timestamp (datetime or
+        None).  Clamped mod ``banks`` so a sloppy ``bank_of`` can never
+        write out of range."""
+        cfg = self.config
+        if cfg.bank_of is None or t is None:
+            return np.int32(0)
+        try:
+            return np.int32(int(cfg.bank_of(t)) % cfg.banks)
+        except Exception:  # pragma: no cover - defensive
+            logger.exception("anomaly bank_of failed; using bank 0")
+            return np.int32(0)
+
+    # -- carry protocol (callers hold aggregator._dev_lock) -------------- #
+
+    def ensure_capacity_locked(self, m: int):
+        """The drift carries, padded to ``m`` rows (new rows start cold:
+        zero profile, zero weight — they score 0 until their baseline
+        establishes).  Returns ``(ihist, (prof, wsum))`` in the fused
+        programs' operand shapes."""
+        k = self.config.banks
+        b = self.wheel.config.num_buckets
+        if self._ihist is None:
+            self._ihist = jnp.zeros((m, b), dtype=jnp.int32)
+        elif self._ihist.shape[0] < m:
+            self._ihist = jnp.concatenate([
+                self._ihist,
+                jnp.zeros((m - self._ihist.shape[0], b), dtype=jnp.int32),
+            ])
+        if self._prof is None:
+            self._prof = jnp.zeros((k, m, b), dtype=jnp.float32)
+            self._wsum = jnp.zeros((k, m), dtype=jnp.float32)
+        elif self._prof.shape[1] < m:
+            gap = m - self._prof.shape[1]
+            self._prof = jnp.concatenate([
+                self._prof,
+                jnp.zeros((k, gap, b), dtype=jnp.float32),
+            ], axis=1)
+            self._wsum = jnp.concatenate([
+                self._wsum,
+                jnp.zeros((k, gap), dtype=jnp.float32),
+            ], axis=1)
+        return self._ihist, (self._prof, self._wsum)
+
+    def store_carry_locked(self, ihist, banks) -> None:
+        self._ihist = ihist
+        self._prof, self._wsum = banks
+
+    def on_device_failure_locked(self) -> None:
+        """A fused dispatch died mid-donation: any consumed carry is
+        rebuilt cold (zeros).  Losing baselines only DELAYS detection —
+        scores stay floored until the EWMA re-establishes, which is the
+        safe failure direction for an alerting signal."""
+        def dead(x):
+            return x is not None and getattr(
+                x, "is_deleted", lambda: False
+            )()
+
+        if dead(self._ihist):
+            self._ihist = None
+        if dead(self._prof) or dead(self._wsum):
+            self._prof = None
+            self._wsum = None
+
+    # -- lifecycle integration (both device locks held) ------------------ #
+
+    def on_evicted_locked(self, victim_ids: np.ndarray) -> None:
+        """Zero the victims' bank rows (every bank) and interval
+        histogram in one donated dispatch — a reused slot must build its
+        baseline from scratch, never inherit the dead series' shape.
+        ``victim_ids`` may be pow2-padded with DROP sentinels."""
+        if self._prof is None:
+            return
+        self._prof, self._wsum, self._ihist = self._evict(
+            self._prof, self._wsum, self._ihist, victim_ids
+        )
+
+    def apply_permutation_locked(self, perm: np.ndarray) -> None:
+        """Repack the bank carries with the lifecycle's survivor
+        permutation (``perm[new] = old``) so baselines follow their rows
+        and freed rows come back cold."""
+        if self._prof is None:
+            return
+        self._prof, self._wsum, self._ihist = self._compact(
+            self._prof, self._wsum, self._ihist, perm
+        )
+
+    # -- scoring ---------------------------------------------------------- #
+
+    def on_interval(self, raw) -> None:
+        """Called by the committer after each committed interval (its
+        thread, no locks held), BEFORE the wheel's hooks — rules see
+        this interval's scores."""
+        self._intervals_seen += 1
+        if self._intervals_seen % self.config.check_every:
+            return
+        try:
+            self.score_now(raw.time)
+        except Exception:  # pragma: no cover - defensive
+            logger.exception("anomaly scoring failed")
+
+    def _view(self, snap):
+        ts = snap.tiers[self.config.tier]
+        view = None
+        if self.config.window is not None:
+            view = ts.view_for(self.config.window)
+        if view is None:
+            # full covered span — always materialized as views[0]
+            view = ts.views[0]
+        return view
+
+    def score_now(self, now=None) -> Optional[Dict[str, np.ndarray]]:
+        """One scoring pass: live view CDF vs the active baseline bank,
+        ONE fused device dispatch.  Returns the host score arrays (or
+        None when there is nothing to score yet)."""
+        snap = self.wheel.snapshot  # atomic ref; handle is immutable
+        if snap is None:
+            self.skipped_intervals += 1
+            return None
+        with self.aggregator._dev_lock:
+            if self._prof is None:
+                self.skipped_intervals += 1
+                return None
+            prof, wsum = self._prof, self._wsum
+            gen = self.aggregator.registry.generation
+        view = self._view(snap)
+        bank = self.bank_for(now)
+        scores = self._div(
+            view.cdf, view.counts, prof, wsum, bank, self.min_count32
+        )
+        host = {k: np.asarray(v) for k, v in scores.items()}
+        with self._scores_lock:
+            self._scores = host
+            self._scores_gen = gen
+            self._scores_epoch = snap.epoch
+            self.scored_intervals += 1
+        self._refresh_export()
+        return host
+
+    def scores_for(self, name: str) -> Optional[Dict[str, float]]:
+        """Latest drift scores for a metric, or None when the metric has
+        no scored row.  Generation-keyed: any registry mutation that can
+        change an id's meaning (eviction, reuse, compaction) invalidates
+        the whole score vector, so a dead or reused id never serves a
+        stale series' score."""
+        reg = self.aggregator.registry
+        with self._scores_lock:
+            scores = self._scores
+            gen = self._scores_gen
+        if scores is None or reg.generation != gen:
+            return None
+        mid = reg.lookup(name)
+        if mid is None or mid >= len(scores["ks"]):
+            return None
+        return {k: float(scores[k][mid]) for k in SCORE_KEYS}
+
+    # -- checkpoint ------------------------------------------------------- #
+
+    def state_dict(self) -> dict:
+        """Host-serializable bank state for utils/checkpoint.py.  The
+        interval histogram is deliberately NOT persisted: it is
+        in-flight interval state, shed on restart like every other
+        interval cache."""
+        with self.aggregator._dev_lock:
+            k = self.config.banks
+            b = self.wheel.config.num_buckets
+            prof = (
+                np.asarray(self._prof) if self._prof is not None
+                else np.zeros((k, 0, b), dtype=np.float32)
+            )
+            wsum = (
+                np.asarray(self._wsum) if self._wsum is not None
+                else np.zeros((k, 0), dtype=np.float32)
+            )
+        return {
+            "prof": prof,
+            "wsum": wsum,
+            "scored_intervals": self.scored_intervals,
+        }
+
+    def load_state(self, state: dict) -> None:
+        prof = np.asarray(state["prof"], dtype=np.float32)
+        wsum = np.asarray(state["wsum"], dtype=np.float32)
+        if prof.shape[0] != self.config.banks:
+            raise ValueError(
+                f"checkpoint has {prof.shape[0]} banks, config has "
+                f"{self.config.banks}"
+            )
+        with self.aggregator._dev_lock:
+            if prof.shape[1]:
+                self._prof = jnp.asarray(prof)
+                self._wsum = jnp.asarray(wsum)
+        self.scored_intervals = int(state.get("scored_intervals", 0))
+
+    # -- gauges ------------------------------------------------------------ #
+
+    def _gauge(self, name: str, key: str) -> Callable[[], float]:
+        def value() -> float:
+            s = self.scores_for(name)
+            return s[key] if s is not None else 0.0
+        return value
+
+    def _refresh_export(self) -> None:
+        """Register ``anomaly.<metric>.{ks,jsd,emd}`` gauges for names
+        matching ``export_glob`` (capped at ``max_export``).  Keyed on
+        (generation, high-water) so a pass with no registry changes is
+        two integer compares."""
+        ms = self.metric_system
+        cfg = self.config
+        if ms is None or cfg.export_glob is None:
+            return
+        reg = self.aggregator.registry
+        key = (reg.generation, len(reg))
+        if key == self._export_key:
+            return
+        self._export_key = key
+        for name in reg.names():
+            if name is None or name in self._exported:
+                continue
+            if len(self._exported) >= cfg.max_export:
+                break
+            if not fnmatch.fnmatch(name, cfg.export_glob):
+                continue
+            self._exported.add(name)
+            for k in SCORE_KEYS:
+                ms.register_gauge_func(
+                    f"anomaly.{name}.{k}", self._gauge(name, k)
+                )
+
+    def register_gauges(self, ms) -> None:
+        """Export the drift-engine self-metric family through the normal
+        gauge pipeline (same shape as commit.* / lifecycle.*)."""
+        ms.register_gauge_func(
+            "anomaly.ScoredIntervals",
+            lambda: float(self.scored_intervals),
+        )
+        ms.register_gauge_func(
+            "anomaly.SkippedIntervals",
+            lambda: float(self.skipped_intervals),
+        )
+        ms.register_gauge_func(
+            "anomaly.ExportedMetrics",
+            lambda: float(len(self._exported)),
+        )
+        ms.register_gauge_func(
+            "anomaly.Banks", lambda: float(self.config.banks)
+        )

@@ -106,10 +106,17 @@ class IntervalCommitter:
         chunk: int = COMMIT_CHUNK,
         staging_depth: int = 2,
         lifecycle=None,
+        anomaly=None,
     ):
         reason = commit_incompatibility(aggregator, wheel)
         if reason is not None:
             raise ValueError(f"fused commit unavailable: {reason}")
+        if anomaly is not None and not wheel.snapshots_enabled:
+            raise ValueError(
+                "drift engine requires commit-time snapshots: the EWMA "
+                "bank update rides the final-chunk snapshot program and "
+                "scoring consumes the published window CDFs"
+            )
         self.aggregator = aggregator
         self.wheel = wheel
         self.chunk = int(chunk)
@@ -117,14 +124,20 @@ class IntervalCommitter:
         # a traced epoch) through the SAME fused programs — activity
         # tracking costs zero extra dispatches on the fused path
         self.lifecycle = lifecycle
+        # an AnomalyManager likewise threads its donated interval
+        # histogram + EWMA baseline banks; the bank decay step runs in
+        # the final-chunk snapshot program — zero extra dispatches
+        self.anomaly = anomaly
         track = lifecycle is not None
-        self._fused = make_fused_commit_fn(len(wheel._tiers), track)
+        track_b = anomaly is not None
+        self._fused = make_fused_commit_fn(len(wheel._tiers), track,
+                                           track_b)
         # final-chunk variant: same fold + the query engine's snapshot
         # emission (per-tier window CDFs + the acc CDF) in ONE dispatch
         self._fused_snap = make_fused_commit_snapshot_fn(
             len(wheel._tiers), wheel.config.bucket_limit,
             wheel.config.precision, wheel.merge_path,
-            track_activity=track,
+            track_activity=track, track_baseline=track_b,
         )
         self._staging = CellStagingRing(depth=staging_depth, width=self.chunk)
 
@@ -207,6 +220,12 @@ class IntervalCommitter:
             mode, dispatches = "empty", 0
         else:
             mode, dispatches = self._commit_cells(cells, raw, dur)
+        if self.anomaly is not None:
+            # score the freshly published snapshot BEFORE the hooks run,
+            # so distribution_drift rules evaluate THIS interval's
+            # scores, not last interval's — same bridge thread, so no
+            # device state races with the commit that just landed
+            self.anomaly.on_interval(raw)
         wheel.run_hooks(raw)
         if self.lifecycle is not None:
             # policy tick OUTSIDE every lock: eviction/compaction work
@@ -316,9 +335,13 @@ class IntervalCommitter:
         ones = np.ones_like(keeps)
         wheel._note_interval_locked(raw.time, (ids, idx, w32))
         lc = self.lifecycle
+        an = self.anomaly
         if lc is not None:
             la = lc.ensure_capacity_locked(agg.num_metrics)
             epoch = np.int32(wheel.intervals_pushed)
+        if an is not None:
+            ihist, banks = an.ensure_capacity_locked(agg.num_metrics)
+            bank = an.bank_for(raw.time)
         emit = wheel.snapshots_enabled
         if emit:
             windows = wheel._view_windows_locked()
@@ -340,49 +363,48 @@ class IntervalCommitter:
                     w32[off:off + take],
                 )
                 chunk_keeps = keeps if dispatches == 0 else ones
-                if emit and off + take >= n:
-                    if lc is not None:
-                        (acc, rings, la, payloads,
-                         acc_payload) = self._fused_snap(
-                            agg._acc, tuple(t.ring for t in tiers),
-                            la, slots, chunk_keeps,
-                            dev_ids, dev_idx, dev_w, epoch, masks,
-                        )
-                        lc.store_carry_locked(la)
-                    else:
-                        acc, rings, payloads, acc_payload = (
-                            self._fused_snap(
-                                agg._acc,
-                                tuple(t.ring for t in tiers),
-                                slots,
-                                chunk_keeps,
-                                dev_ids,
-                                dev_idx,
-                                dev_w,
-                                masks,
-                            )
-                        )
-                else:
-                    if lc is not None:
-                        acc, rings, la = self._fused(
-                            agg._acc, tuple(t.ring for t in tiers),
-                            la, slots, chunk_keeps,
-                            dev_ids, dev_idx, dev_w, epoch,
-                        )
-                        lc.store_carry_locked(la)
-                    else:
-                        acc, rings = self._fused(
-                            agg._acc,
-                            tuple(t.ring for t in tiers),
-                            slots,
-                            chunk_keeps,
-                            dev_ids,
-                            dev_idx,
-                            dev_w,
-                        )
-                agg._acc = acc
-                for t, r in zip(tiers, rings):
+                final = emit and off + take >= n
+                # operand ordering per make_fused_commit_fn /
+                # make_fused_commit_snapshot_fn: carries first (acc,
+                # rings, [la], [ihist], [banks]), then cells, then the
+                # traced scalars ([epoch], [masks], [ifirst, bank,
+                # decay, min_count])
+                args = [agg._acc, tuple(t.ring for t in tiers)]
+                if lc is not None:
+                    args.append(la)
+                if an is not None:
+                    args.append(ihist)
+                    if final:
+                        args.append(banks)
+                args += [slots, chunk_keeps, dev_ids, dev_idx, dev_w]
+                if lc is not None:
+                    args.append(epoch)
+                if final:
+                    args.append(masks)
+                if an is not None:
+                    # 0 on the interval's FIRST chunk clears the
+                    # previous interval's histogram; later chunks keep
+                    # accumulating into it
+                    args.append(np.int32(0 if dispatches == 0 else 1))
+                    if final:
+                        args += [bank, an.decay32, an.min_count32]
+                out = iter(
+                    (self._fused_snap if final else self._fused)(*args)
+                )
+                agg._acc = next(out)
+                for t, r in zip(tiers, next(out)):
                     t.ring = r
+                if lc is not None:
+                    la = next(out)
+                    lc.store_carry_locked(la)
+                if an is not None:
+                    ihist = next(out)
+                    if final:
+                        banks = next(out)
+                    an.store_carry_locked(ihist, banks)
+                if final:
+                    payloads = next(out)
+                    acc_payload = next(out)
                 dispatches += 1
                 applied = off + take
                 agg._device_down_until = 0.0
@@ -429,6 +451,11 @@ class IntervalCommitter:
             # the activity carry was donated into the failed dispatch;
             # rebuild it stamped "just active" (delays evictions only)
             self.lifecycle.on_device_failure_locked()
+        if self.anomaly is not None:
+            # likewise the interval histogram / baseline banks: rebuild
+            # cold (drift detection restarts its EWMA warm-up — scores
+            # stay floored until baselines re-establish, never wrong)
+            self.anomaly.on_device_failure_locked()
         # the published wheel handle may describe rings this failure
         # consumed; queries fall back to locked recompute until the next
         # successful commit republishes
@@ -473,31 +500,61 @@ class IntervalCommitter:
         channel."""
         agg, wheel = self.aggregator, self.wheel
         lc = self.lifecycle
+        an = self.anomaly
         empty = np.empty(0, dtype=np.int32)
+
+        def run(fn, final):
+            dev_ids, dev_idx, dev_w = self._staging.stage(
+                empty, empty, empty
+            )
+            args = [agg._acc, tuple(t.ring for t in tiers)]
+            if lc is not None:
+                args.append(la)
+            if an is not None:
+                args.append(ihist)
+                if final:
+                    args.append(banks)
+            args += [slots, keeps, dev_ids, dev_idx, dev_w]
+            if lc is not None:
+                args.append(epoch)
+            if final:
+                args.append(masks)
+            if an is not None:
+                # ifirst=1 with zero cells: the (all-zero) interval
+                # histogram carries through unchanged, and zero counts
+                # never clear the min_count bar — numerically a no-op
+                args.append(np.int32(1))
+                if final:
+                    args += [an.bank_for(None), an.decay32,
+                             an.min_count32]
+            out = iter(fn(*args))
+            agg._acc = next(out)
+            for t, r in zip(tiers, next(out)):
+                t.ring = r
+            if lc is not None:
+                lc.store_carry_locked(next(out))
+            if an is not None:
+                ih = next(out)
+                bk = next(out) if final else banks
+                an.store_carry_locked(ih, bk)
+                return ih, bk
+            return None, None
+
         with agg._dev_lock:
             with wheel._lock:
                 tiers = wheel._tiers
                 slots = np.asarray([t.slot for t in tiers], dtype=np.int32)
                 keeps = np.ones(len(tiers), dtype=np.int32)
-                dev_ids, dev_idx, dev_w = self._staging.stage(
-                    empty, empty, empty
-                )
                 if lc is not None:
                     la = lc.ensure_capacity_locked(agg.num_metrics)
                     epoch = np.int32(wheel.intervals_pushed)
-                    acc, rings, la = self._fused(
-                        agg._acc, tuple(t.ring for t in tiers), la,
-                        slots, keeps, dev_ids, dev_idx, dev_w, epoch,
+                if an is not None:
+                    ihist, banks = an.ensure_capacity_locked(
+                        agg.num_metrics
                     )
-                    lc.store_carry_locked(la)
-                else:
-                    acc, rings = self._fused(
-                        agg._acc, tuple(t.ring for t in tiers),
-                        slots, keeps, dev_ids, dev_idx, dev_w,
-                    )
-                agg._acc = acc
-                for t, r in zip(tiers, rings):
-                    t.ring = r
+                ihist, banks = run(self._fused, final=False)
+                if lc is not None:
+                    la = lc.ensure_capacity_locked(agg.num_metrics)
                 if wheel.snapshots_enabled:
                     # warm the final-chunk (snapshot-emitting) variant at
                     # the same shapes; all-False masks make the payloads
@@ -507,25 +564,7 @@ class IntervalCommitter:
                         np.zeros((len(windows), t.spec.slots), dtype=bool)
                         for t in tiers
                     )
-                    dev_ids, dev_idx, dev_w = self._staging.stage(
-                        empty, empty, empty
-                    )
-                    if lc is not None:
-                        acc, rings, la, _, _ = self._fused_snap(
-                            agg._acc, tuple(t.ring for t in tiers),
-                            lc.ensure_capacity_locked(agg.num_metrics),
-                            slots, keeps, dev_ids, dev_idx, dev_w,
-                            epoch, masks,
-                        )
-                        lc.store_carry_locked(la)
-                    else:
-                        acc, rings, _, _ = self._fused_snap(
-                            agg._acc, tuple(t.ring for t in tiers),
-                            slots, keeps, dev_ids, dev_idx, dev_w, masks,
-                        )
-                    agg._acc = acc
-                    for t, r in zip(tiers, rings):
-                        t.ring = r
+                    run(self._fused_snap, final=True)
 
     def attach(self, ms: MetricSystem, channel_capacity: int = 8) -> None:
         """Subscribe ONCE behind the raw boundary for both consumers —

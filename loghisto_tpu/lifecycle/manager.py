@@ -84,6 +84,12 @@ class LifecycleManager:
         )
         self._touch = make_touch_fn()
 
+        # the drift engine's baseline banks live and die with the rows
+        # this manager evicts/compacts; set by TPUMetricSystem wiring
+        # (an AnomalyManager) so bank rows are zeroed with their victims
+        # and permuted with their survivors
+        self.anomaly = None
+
         # device activity vector; sized lazily to the accumulator's row
         # count (guarded by aggregator._dev_lock, like the accumulator)
         self._la: Optional[jnp.ndarray] = None
@@ -236,6 +242,11 @@ class LifecycleManager:
                     t.ring = r
                 self._la = la
                 vcounts = np.asarray(vcounts)[: len(vids)]
+                if self.anomaly is not None:
+                    # zero the victims' drift baselines in the same
+                    # critical section: the freed slots' next tenants
+                    # must start cold, not inherit a dead shape
+                    self.anomaly.on_evicted_locked(vpad)
                 if agg._spill is not None:
                     for mid, _, omid, _ in pairs:
                         if mid < len(agg._spill):
@@ -327,12 +338,17 @@ class LifecycleManager:
                     )
                     agg._on_device_failure_locked()
                     self.on_device_failure_locked()
+                    if self.anomaly is not None:
+                        self.anomaly.on_device_failure_locked()
                     wheel.lifecycle_invalidated_locked()
                     return False
                 agg._acc = acc
                 for t, r in zip(wheel._tiers, rings):
                     t.ring = r
                 self._la = la
+                if self.anomaly is not None:
+                    # baselines follow their rows through the repack
+                    self.anomaly.apply_permutation_locked(perm)
                 if agg._spill is not None:
                     spill = np.zeros_like(agg._spill)
                     nsrc = [s for s in live if s < len(agg._spill)]

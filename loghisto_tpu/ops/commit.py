@@ -56,12 +56,16 @@ DROP_ID = np.int32(2**30)
 
 
 @functools.lru_cache(maxsize=None)
-def make_fused_commit_fn(num_tiers: int, track_activity: bool = False):
+def make_fused_commit_fn(
+    num_tiers: int,
+    track_activity: bool = False,
+    track_baseline: bool = False,
+):
     """Build the fused commit program for ``num_tiers`` retention tiers.
-    Cached per (tier count, activity flag): the jitted program is
-    shape-polymorphic, so every committer with the same signature shares
-    one jit object (and its per-shape executable cache) instead of
-    recompiling.
+    Cached per (tier count, activity flag, baseline flag): the jitted
+    program is shape-polymorphic, so every committer with the same
+    signature shares one jit object (and its per-shape executable
+    cache) instead of recompiling.
 
     Returns ``commit(acc, rings, slots, keeps, ids, idx, weights) ->
     (acc, rings)`` where
@@ -90,36 +94,44 @@ def make_fused_commit_fn(num_tiers: int, track_activity: bool = False):
     separate paths had.
 
     With ``track_activity`` the signature gains a donated int32 [M]
-    ``last_active`` carry and a traced int32 ``epoch`` —
-    ``commit(acc, rings, last_active, slots, keeps, ids, idx, weights,
-    epoch) -> (acc, rings, last_active)`` — and the program additionally
-    stamps ``last_active[ids] = max(., epoch)`` over the interval's
-    touched rows.  Same cells, same dispatch: the lifecycle subsystem's
-    activity vector costs zero extra launches, the identical fusion
-    economics as the snapshot variant's commit-time CDFs.
+    ``last_active`` carry and a traced int32 ``epoch`` — inserted after
+    ``rings`` and after ``weights`` respectively — and the program
+    additionally stamps ``last_active[ids] = max(., epoch)`` over the
+    interval's touched rows.  Same cells, same dispatch: the lifecycle
+    subsystem's activity vector costs zero extra launches, the
+    identical fusion economics as the snapshot variant's commit-time
+    CDFs.
+
+    With ``track_baseline`` the signature further gains a donated int32
+    [M, B] ``ihist`` carry (after ``last_active``) and a trailing
+    traced int32 ``ifirst``: the program folds the SAME cells into the
+    interval histogram after multiplying it by ``ifirst`` (0 on an
+    interval's first chunk — clearing the previous interval — 1 on
+    later chunks).  The completed ``ihist`` feeds the drift engine's
+    EWMA baseline update in the final-chunk snapshot variant; like the
+    activity stamp, it rides the commit dispatch for free.
+
+    Full ordering with both flags:
+    ``commit(acc, rings, last_active, ihist, slots, keeps, ids, idx,
+    weights, epoch, ifirst) -> (acc, rings, last_active, ihist)``.
     """
+    donate = tuple(range(2 + int(track_activity) + int(track_baseline)))
 
-    if track_activity:
+    @functools.partial(jax.jit, donate_argnums=donate)
+    def commit(*args):
+        it = iter(args)
+        acc = next(it)
+        rings = next(it)
+        last_active = next(it) if track_activity else None
+        ihist = next(it) if track_baseline else None
+        slots = next(it)
+        keeps = next(it)
+        ids = next(it)
+        idx = next(it)
+        weights = next(it)
+        epoch = next(it) if track_activity else None
+        ifirst = next(it) if track_baseline else None
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def commit_la(acc, rings, last_active, slots, keeps, ids, idx,
-                      weights, epoch):
-            acc = acc.at[ids, idx].add(weights, mode="drop")
-            new_rings = []
-            for t in range(num_tiers):
-                ring = rings[t]
-                ring = ring.at[slots[t]].multiply(keeps[t], mode="drop")
-                ring = ring.at[slots[t], ids, idx].add(
-                    weights, mode="drop"
-                )
-                new_rings.append(ring)
-            last_active = last_active.at[ids].max(epoch, mode="drop")
-            return acc, tuple(new_rings), last_active
-
-        return commit_la
-
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def commit(acc, rings, slots, keeps, ids, idx, weights):
         acc = acc.at[ids, idx].add(weights, mode="drop")
         new_rings = []
         for t in range(num_tiers):
@@ -127,7 +139,13 @@ def make_fused_commit_fn(num_tiers: int, track_activity: bool = False):
             ring = ring.at[slots[t]].multiply(keeps[t], mode="drop")
             ring = ring.at[slots[t], ids, idx].add(weights, mode="drop")
             new_rings.append(ring)
-        return acc, tuple(new_rings)
+        out = [acc, tuple(new_rings)]
+        if track_activity:
+            out.append(last_active.at[ids].max(epoch, mode="drop"))
+        if track_baseline:
+            ihist = ihist * ifirst
+            out.append(ihist.at[ids, idx].add(weights, mode="drop"))
+        return tuple(out)
 
     return commit
 
@@ -139,6 +157,7 @@ def make_fused_commit_snapshot_fn(
     precision: int = PRECISION,
     merge_path: str = "jnp",
     track_activity: bool = False,
+    track_baseline: bool = False,
 ):
     """The fused commit program's FINAL-chunk variant: same donated-carry
     fold as ``make_fused_commit_fn`` plus, in the SAME dispatch, the
@@ -164,36 +183,49 @@ def make_fused_commit_snapshot_fn(
     ``make_fused_commit_fn`` — the final chunk of an interval then pays
     the scatter fold, every snapshot payload, AND the activity stamp in
     one dispatch.
+
+    ``track_baseline`` threads the drift engine's carries: the donated
+    int32 [M, B] ``ihist`` interval histogram (as in
+    ``make_fused_commit_fn``), a donated ``banks = (prof f32 [K, M, B],
+    wsum f32 [K, M])`` EWMA baseline-bank pytree, and trailing traced
+    scalars ``ifirst, bank, decay, min_count``.  Because this is the
+    interval's FINAL chunk, the completed interval histogram decays
+    into baseline bank ``bank`` here (``ops.anomaly.ewma_bank_update``;
+    rows under ``min_count`` skip the update) — the whole EWMA baseline
+    maintenance rides the commit dispatch, zero extra launches.
+
+    Full ordering with both flags:
+    ``commit(acc, rings, last_active, ihist, banks, slots, keeps, ids,
+    idx, weights, epoch, masks, ifirst, bank, decay, min_count) ->
+    (acc, rings, last_active, ihist, banks, tier_payloads,
+    acc_payload)``.
     """
+    if track_baseline:
+        # Deferred: ops.anomaly -> ops.lifecycle -> ops.commit cycle.
+        from loghisto_tpu.ops.anomaly import ewma_bank_update
+    donate = tuple(range(2 + int(track_activity) + 2 * int(track_baseline)))
 
-    if track_activity:
+    @functools.partial(jax.jit, donate_argnums=donate)
+    def commit(*args):
+        it = iter(args)
+        acc = next(it)
+        rings = next(it)
+        last_active = next(it) if track_activity else None
+        ihist = next(it) if track_baseline else None
+        banks = next(it) if track_baseline else None
+        slots = next(it)
+        keeps = next(it)
+        ids = next(it)
+        idx = next(it)
+        weights = next(it)
+        epoch = next(it) if track_activity else None
+        masks = next(it)
+        if track_baseline:
+            ifirst = next(it)
+            bank = next(it)
+            decay = next(it)
+            min_count = next(it)
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def commit_la(acc, rings, last_active, slots, keeps, ids, idx,
-                      weights, epoch, masks):
-            acc = acc.at[ids, idx].add(weights, mode="drop")
-            new_rings = []
-            payloads = []
-            for t in range(num_tiers):
-                ring = rings[t]
-                ring = ring.at[slots[t]].multiply(keeps[t], mode="drop")
-                ring = ring.at[slots[t], ids, idx].add(
-                    weights, mode="drop"
-                )
-                new_rings.append(ring)
-                payloads.append(
-                    window_snapshot(ring, masks[t], bucket_limit,
-                                    precision, merge_path)
-                )
-            last_active = last_active.at[ids].max(epoch, mode="drop")
-            acc_payload = dense_cdf(acc, bucket_limit, precision)
-            return (acc, tuple(new_rings), last_active, tuple(payloads),
-                    acc_payload)
-
-        return commit_la
-
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def commit(acc, rings, slots, keeps, ids, idx, weights, masks):
         acc = acc.at[ids, idx].add(weights, mode="drop")
         new_rings = []
         payloads = []
@@ -206,8 +238,18 @@ def make_fused_commit_snapshot_fn(
                 window_snapshot(ring, masks[t], bucket_limit, precision,
                                 merge_path)
             )
+        out = [acc, tuple(new_rings)]
+        if track_activity:
+            out.append(last_active.at[ids].max(epoch, mode="drop"))
+        if track_baseline:
+            ihist = ihist * ifirst
+            ihist = ihist.at[ids, idx].add(weights, mode="drop")
+            out.append(ihist)
+            out.append(ewma_bank_update(banks, ihist, bank, decay,
+                                        min_count))
         acc_payload = dense_cdf(acc, bucket_limit, precision)
-        return acc, tuple(new_rings), tuple(payloads), acc_payload
+        out.extend((tuple(payloads), acc_payload))
+        return tuple(out)
 
     return commit
 

@@ -50,6 +50,7 @@ class TPUMetricSystem(MetricSystem):
         retention=None,
         commit: str = "auto",
         lifecycle=None,
+        anomaly=None,
         transport: str = "auto",
     ):
         """``retention`` turns on the windowed retention tier:
@@ -79,6 +80,16 @@ class TPUMetricSystem(MetricSystem):
         reports the churn.  Requires retention + the fused commit path
         (the subsystem's clock and activity signal ARE the committed
         intervals).
+
+        ``anomaly`` takes an ``anomaly.AnomalyConfig`` and turns on the
+        distribution drift engine: per-metric EWMA baseline bucket
+        profiles ride the fused commit (zero extra dispatches), one
+        fused divergence dispatch per interval scores every metric's
+        live window CDF against its baseline (KS / JSD / bucket-space
+        EMD), ``DistributionDriftRule``s alert on the scores through
+        the normal rule engine, and ``anomaly.<metric>.{ks,jsd,emd}``
+        gauges ride every exporter.  Requires retention + the fused
+        commit path, like ``lifecycle``.
 
         ``transport`` passes through to the TPUAggregator's host->device
         transport selection ("auto" / "raw" / "preagg" / "sparse"; see
@@ -138,10 +149,16 @@ class TPUMetricSystem(MetricSystem):
             commit, platform, mesh=mesh is not None
         )
         self.lifecycle = None
+        self.anomaly = None
         if lifecycle is not None and self.retention is None:
             raise ValueError(
                 "lifecycle needs retention: construct with "
                 "TPUMetricSystem(retention=True, lifecycle=...)"
+            )
+        if anomaly is not None and self.retention is None:
+            raise ValueError(
+                "the drift engine needs retention: construct with "
+                "TPUMetricSystem(retention=True, anomaly=...)"
             )
         if self.commit_path == "fused" and self.retention is not None:
             from loghisto_tpu.commit import (
@@ -158,11 +175,25 @@ class TPUMetricSystem(MetricSystem):
                         metric_system=self,
                     )
                     self.lifecycle.register_gauges(self)
+                if anomaly is not None:
+                    from loghisto_tpu.anomaly import AnomalyManager
+
+                    self.anomaly = AnomalyManager(
+                        self.aggregator, self.retention, anomaly,
+                        metric_system=self,
+                    )
+                    self.anomaly.register_gauges(self)
+                    if self.lifecycle is not None:
+                        # evictions zero bank rows, compaction permutes
+                        # them — inside the lifecycle's own critical
+                        # sections
+                        self.lifecycle.anomaly = self.anomaly
                 # ONE subscription pays both consumers: neither the
                 # aggregator bridge nor the wheel bridge attaches
                 self.committer = IntervalCommitter(
                     self.aggregator, self.retention,
                     lifecycle=self.lifecycle,
+                    anomaly=self.anomaly,
                 )
                 self.committer.attach(self)
                 self.committer.register_gauges(self)
@@ -184,6 +215,13 @@ class TPUMetricSystem(MetricSystem):
                     f"configuration resolved commit={self.commit_path!r}"
                     " (mesh-sharded and fan-out pipelines don't carry "
                     "the activity vector)"
+                )
+            if anomaly is not None:
+                raise ValueError(
+                    "the drift engine rides the fused interval commit; "
+                    "this configuration resolved "
+                    f"commit={self.commit_path!r} (mesh-sharded and "
+                    "fan-out pipelines don't carry the baseline banks)"
                 )
             self.aggregator.attach(self)
             if self.retention is not None:
@@ -236,8 +274,18 @@ class TPUMetricSystem(MetricSystem):
     def add_rule(self, rule):
         """Register an alerting rule (window.rules.*Rule), evaluated
         after every interval; its state gauges join this system's
-        exporters immediately."""
+        exporters immediately.  ``DistributionDriftRule``s are bound to
+        this system's AnomalyManager automatically (requires
+        ``anomaly=AnomalyConfig(...)``)."""
         self._require_retention()
+        if getattr(rule, "kind", None) == "distribution_drift":
+            if self.anomaly is None:
+                raise ValueError(
+                    "distribution_drift rules need the drift engine: "
+                    "construct with TPUMetricSystem(retention=True, "
+                    "anomaly=AnomalyConfig(...))"
+                )
+            rule.bind(self.anomaly)
         self.rule_engine.add(rule)
         self.rule_engine.register_gauges(self)
         return rule
@@ -252,9 +300,20 @@ class TPUMetricSystem(MetricSystem):
 
     def backfill_retention(self, intervals: Iterable[RawMetricSet]) -> int:
         """Replay journaled intervals (utils.journal.replay(path)) into
-        the retention wheel — offline reconstruction of window state.
+        the retention state — offline reconstruction of window state.
+        On the fused commit path the replay runs through the interval
+        committer (the system's single interval consumer), so lifecycle
+        activity and drift baselines rebuild alongside the wheel and
+        the aggregator sees the samples exactly as it would have live.
         Returns the number of intervals pushed."""
-        return self._require_retention().backfill(intervals)
+        self._require_retention()
+        if self.committer is not None:
+            n = 0
+            for raw in intervals:
+                self.committer.commit(raw)
+                n += 1
+            return n
+        return self.retention.backfill(intervals)
 
     # ------------------------------------------------------------------ #
 

@@ -33,6 +33,7 @@ def save(
     metric_system: Optional[MetricSystem] = None,
     aggregator=None,
     lifecycle=None,
+    anomaly=None,
 ) -> None:
     """Atomically snapshot lifetime state to `path` (.npz).
 
@@ -41,7 +42,12 @@ def save(
     generation.  Overflow metric state needs no special handling — the
     catch-all series are ordinary named rows, so they ride the
     accumulator / lifetime-aggregate payloads like any other metric
-    (tests/test_checkpoint.py round-trips this)."""
+    (tests/test_checkpoint.py round-trips this).
+
+    ``anomaly`` (an anomaly.AnomalyManager) persists the EWMA baseline
+    banks (profile + weight mass) so drift detection resumes warm
+    after a restart instead of re-learning every baseline; rows are
+    remapped by NAME on restore like every other per-row payload."""
     payload = {"version": np.int64(FORMAT_VERSION)}
 
     if metric_system is not None:
@@ -115,6 +121,14 @@ def save(
             dtype=np.int64,
         )
 
+    if anomaly is not None:
+        st = anomaly.state_dict()
+        payload["an_prof"] = st["prof"]
+        payload["an_wsum"] = st["wsum"]
+        payload["an_counters"] = np.array(
+            [st["scored_intervals"]], dtype=np.int64
+        )
+
     directory = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     try:
@@ -136,6 +150,7 @@ def restore(
     metric_system: Optional[MetricSystem] = None,
     aggregator=None,
     lifecycle=None,
+    anomaly=None,
 ) -> None:
     """Restore lifetime state saved by save().  Loads into the provided
     objects (merging over their current lifetime state).  With
@@ -308,6 +323,26 @@ def restore(
                     "overflowed_samples": int(counters[1]),
                     "evictions": int(counters[2]),
                     "compactions": int(counters[3]),
+                })
+            if anomaly is not None and "an_prof" in data:
+                # bank rows remap through the same by-name id map as
+                # the accumulator — a baseline never lands on a row its
+                # name doesn't own in the target registry
+                saved_prof = np.asarray(data["an_prof"], dtype=np.float32)
+                saved_wsum = np.asarray(data["an_wsum"], dtype=np.float32)
+                k, ms_rows, b = saved_prof.shape
+                m = aggregator.num_metrics
+                prof = np.zeros((k, m, b), dtype=np.float32)
+                wsum = np.zeros((k, m), dtype=np.float32)
+                for saved_id, new_id in id_remap.items():
+                    if saved_id < ms_rows and new_id < m:
+                        prof[:, new_id] = saved_prof[:, saved_id]
+                        wsum[:, new_id] = saved_wsum[:, saved_id]
+                counters = data["an_counters"]
+                anomaly.load_state({
+                    "prof": prof,
+                    "wsum": wsum,
+                    "scored_intervals": int(counters[0]),
                 })
 
 

@@ -10,6 +10,7 @@ from loghisto_tpu.window.rules import (
     Alert,
     FIRING,
     RESOLVED,
+    DistributionDriftRule,
     RateOfChangeRule,
     Rule,
     RuleEngine,
@@ -33,6 +34,7 @@ from loghisto_tpu.window.store import (
 __all__ = [
     "Alert",
     "DEFAULT_TIERS",
+    "DistributionDriftRule",
     "FIRING",
     "RESOLVED",
     "QueryPlanCache",

@@ -279,6 +279,71 @@ class SloBurnRateRule(Rule):
         )
 
 
+class DistributionDriftRule(Rule):
+    """Fire when a metric's distribution SHAPE drifts from its EWMA
+    baseline — the divergence scores computed by the anomaly subsystem
+    (see ``loghisto_tpu.anomaly``), not any scalar statistic, so a
+    bimodal latency regression pages even while p50 (or p99) sits flat,
+    and a pure-rate change (same shape, more traffic) never does.
+
+    ``stat`` picks the divergence: "jsd" (Jensen–Shannon, [0, 1] — the
+    default; symmetric, bounded, shape-only), "ks" (max CDF gap,
+    [0, 1]), or "emd" (bucket-space earth-mover's, in bucket-index
+    units ~= precision-% steps).  Thresholds are in the chosen score's
+    units.
+
+    The rule reads host-side scores (``AnomalyManager.scores_for`` —
+    generation-keyed, so a dead/reused id reads as no-data, which is
+    non-breaching).  Unbound rules or unscored metrics observe None —
+    the standard "no data must not page" contract.  ``TPUMetricSystem.
+    add_rule`` binds the system's manager automatically; standalone use
+    passes ``manager=`` directly."""
+
+    kind = "distribution_drift"
+
+    def __init__(
+        self,
+        name: str,
+        metric: str,
+        stat: str = "jsd",
+        threshold: float = 0.1,
+        for_intervals: int = 1,
+        manager=None,
+    ):
+        super().__init__(name, threshold, for_intervals)
+        if stat not in ("ks", "jsd", "emd"):
+            raise ValueError(
+                f"stat must be 'ks', 'jsd', or 'emd', got {stat!r}"
+            )
+        self.metric = metric
+        self.stat = stat
+        self._manager = manager
+
+    def bind(self, manager) -> None:
+        """Attach the AnomalyManager serving this rule's scores."""
+        self._manager = manager
+
+    def observe(self, wheel: TimeWheel):
+        if self._manager is None:
+            return None, False
+        scores = self._manager.scores_for(self.metric)
+        if scores is None:
+            return None, False
+        value = scores[self.stat]
+        return value, value > self.threshold
+
+    def describe(self) -> str:
+        return (
+            f"{self.metric} distribution drift {self.stat} > "
+            f"{self.threshold:g}"
+        )
+
+    def device_windows(self) -> tuple:
+        # the manager pins its own scoring window; the rule itself
+        # queries nothing on device
+        return ()
+
+
 class RuleEngine:
     """Evaluates registered rules against a wheel each interval and
     broadcasts alert transitions.

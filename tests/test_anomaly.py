@@ -1,0 +1,547 @@
+"""Distribution drift engine (the PR-7 tentpole): EWMA baseline banks
+riding the fused commit, fused divergence scoring (KS / JSD / bucket
+EMD), and drift-aware alerting.  Pins the acceptance criteria: at most
+ONE device dispatch per interval beyond the fused commit (EWMA updates
+cost zero — they ride the final-chunk program), jnp and Pallas
+divergence tiers bit-identical, a bimodal shape shift at flat p50 fires
+``distribution_drift`` while a pure-rate change does not, and the
+generation-keyed score contract (a dead or reused id never serves a
+stale series' drift score — eviction, slot reuse, AND compaction)."""
+
+import datetime as dt
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from loghisto_tpu.anomaly import AnomalyConfig, AnomalyManager, hourly_bank
+from loghisto_tpu.commit import IntervalCommitter
+from loghisto_tpu.config import MetricConfig
+from loghisto_tpu.lifecycle import LifecycleConfig, LifecycleManager
+from loghisto_tpu.metrics import RawMetricSet
+from loghisto_tpu.ops.anomaly import (
+    divergence_scores,
+    ewma_bank_update,
+    make_divergence_fn,
+    resolve_divergence_path,
+)
+from loghisto_tpu.parallel.aggregator import TPUAggregator
+from loghisto_tpu.window import DistributionDriftRule, RuleEngine, TimeWheel
+
+pytestmark = pytest.mark.anomaly
+
+T0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+
+
+def _raw(i, histograms=None, duration=1.0):
+    return RawMetricSet(
+        time=T0 + dt.timedelta(seconds=i), counters={}, rates={},
+        histograms=dict(histograms or {}), gauges={}, duration=duration,
+    )
+
+
+def _pair(
+    num_metrics=16,
+    bucket_limit=256,
+    tiers=((4, 1),),
+    config=None,
+    lifecycle=None,
+):
+    cfg = MetricConfig(bucket_limit=bucket_limit)
+    agg = TPUAggregator(num_metrics=num_metrics, config=cfg)
+    wheel = TimeWheel(num_metrics=num_metrics, config=cfg, interval=1.0,
+                      tiers=tiers, registry=agg.registry)
+    am = AnomalyManager(agg, wheel, config or AnomalyConfig(
+        decay=0.8, min_samples=16,
+    ))
+    lc = None
+    if lifecycle is not None:
+        lc = LifecycleManager(agg, wheel, lifecycle)
+        lc.anomaly = am
+    committer = IntervalCommitter(agg, wheel, lifecycle=lc, anomaly=am)
+    committer.warmup()
+    return committer, agg, wheel, am, lc
+
+
+# the two distribution shapes the acceptance test contrasts: identical
+# median (bucket 100), radically different shape
+UNIMODAL = {90: 100, 100: 200, 110: 100}
+BIMODAL = {50: 120, 90: 40, 100: 160, 110: 40, 150: 120}  # p50 still 100
+
+
+# ---------------------------------------------------------------------- #
+# kernel math: EWMA oracle, jnp/Pallas parity, the floor mask
+# ---------------------------------------------------------------------- #
+
+def test_ewma_bank_update_matches_numpy_oracle():
+    rng = np.random.default_rng(7)
+    k, m, b = 3, 12, 10
+    prof = rng.random((k, m, b)).astype(np.float32)
+    wsum = rng.random((k, m)).astype(np.float32)
+    ihist = rng.integers(0, 40, (m, b)).astype(np.int32)
+    ihist[4] = 0                      # quiet row: must keep its baseline
+    ihist[5, :] = [1] + [0] * (b - 1)  # below floor: count 1 < 8
+    decay, min_count, bank = np.float32(0.75), np.int32(8), np.int32(1)
+
+    new_p, new_w = ewma_bank_update(
+        (jnp.asarray(prof), jnp.asarray(wsum)),
+        jnp.asarray(ihist), bank, decay, min_count,
+    )
+    new_p, new_w = np.asarray(new_p), np.asarray(new_w)
+
+    counts = ihist.sum(axis=1)
+    upd = counts >= 8
+    pmf = ihist / np.maximum(counts, 1)[:, None]
+    want_p = prof.copy()
+    want_w = wsum.copy()
+    want_p[1][upd] = 0.75 * prof[1][upd] + 0.25 * pmf[upd]
+    want_w[1][upd] = 0.75 * wsum[1][upd] + 0.25
+
+    np.testing.assert_allclose(new_p, want_p, rtol=1e-6)
+    np.testing.assert_allclose(new_w, want_w, rtol=1e-6)
+    # rows below the floor and the OTHER banks are bitwise untouched
+    assert (new_p[[0, 2]] == prof[[0, 2]]).all()
+    assert (new_p[1][~upd] == prof[1][~upd]).all()
+    assert (new_w[1][~upd] == wsum[1][~upd]).all()
+
+
+def test_ewma_bias_correction_reproduces_constant_pmf():
+    # feeding the same shape forever, prof/wsum must equal that pmf from
+    # the very first update (bias-corrected), not EWMA-attenuated
+    b = 8
+    ihist = np.zeros((2, b), dtype=np.int32)
+    ihist[0, :4] = [10, 20, 10, 60]
+    prof = jnp.zeros((1, 2, b), dtype=jnp.float32)
+    wsum = jnp.zeros((1, 2), dtype=jnp.float32)
+    for _ in range(5):
+        prof, wsum = ewma_bank_update(
+            (prof, wsum), jnp.asarray(ihist),
+            np.int32(0), np.float32(0.9), np.int32(1),
+        )
+        base = np.asarray(prof[0, 0]) / np.asarray(wsum[0, 0])
+        np.testing.assert_allclose(
+            base, ihist[0] / ihist[0].sum(), rtol=1e-6
+        )
+
+
+def test_divergence_pallas_bit_identical_to_jnp():
+    # parity is pinned at the product surface — make_divergence_fn jits
+    # both tiers, and under jit the row reductions lower identically.
+    # (The EAGER jnp path may differ by an ulp in the jsd sum; the
+    # engine never runs it.)
+    jnp_fn = make_divergence_fn("jnp")
+    pallas_fn = make_divergence_fn("pallas")
+    for seed, m in ((11, 21), (12, 5), (13, 64)):
+        rng = np.random.default_rng(seed)
+        b = 24  # deliberately not a multiple of the 8-row tile
+        bins = rng.integers(0, 50, (m, b)).astype(np.int32)
+        cdf = jnp.asarray(np.cumsum(bins, axis=1, dtype=np.int32))
+        counts = jnp.asarray(bins.sum(axis=1).astype(np.int32))
+        prof = jnp.asarray(rng.random((2, m, b)).astype(np.float32))
+        w = jnp.asarray(rng.random((2, m)).astype(np.float32))
+        # a couple of floored rows so the mask path is covered too
+        counts = counts.at[0].set(0)
+        w = w.at[1, 1].set(0.0)
+        a = jnp_fn(cdf, counts, prof, w, np.int32(1), np.int32(5))
+        p = pallas_fn(cdf, counts, prof, w, np.int32(1), np.int32(5))
+        for name in ("ks", "jsd", "emd"):
+            x, y = np.asarray(a[name]), np.asarray(p[name])
+            assert x.shape == (m,)
+            assert (x == y).all(), (
+                f"{name} tier mismatch at m={m} (must be bitwise)"
+            )
+
+
+def test_divergence_scores_floor_and_cold_baseline():
+    b = 16
+    bins = np.zeros((4, b), dtype=np.int32)
+    bins[0, 2] = 100   # hot row, established baseline, shifted shape
+    bins[1, 2] = 3     # below the min-sample floor
+    bins[2, 2] = 100   # hot row but cold baseline (wsum == 0)
+    cdf = jnp.asarray(np.cumsum(bins, axis=1, dtype=np.int32))
+    counts = jnp.asarray(bins.sum(axis=1).astype(np.int32))
+    prof = np.zeros((1, 4, b), dtype=np.float32)
+    wsum = np.zeros((1, 4), dtype=np.float32)
+    prof[0, 0, 10] = 1.0  # baseline mass at bucket 10; live at bucket 2
+    prof[0, 1, 10] = 1.0
+    wsum[0, 0] = wsum[0, 1] = 1.0
+    out = divergence_scores(
+        cdf, counts, jnp.asarray(prof), jnp.asarray(wsum),
+        np.int32(0), np.int32(10),
+    )
+    ks = np.asarray(out["ks"])
+    jsd = np.asarray(out["jsd"])
+    emd = np.asarray(out["emd"])
+    # disjoint supports: ks == 1, jsd == 1 (bounded), emd == 8 buckets
+    np.testing.assert_allclose(ks[0], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(jsd[0], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(emd[0], 8.0, rtol=1e-6)
+    # floored / cold / empty rows are EXACTLY zero, never approximately
+    assert ks[1] == 0.0 and jsd[1] == 0.0 and emd[1] == 0.0
+    assert ks[2] == 0.0 and jsd[2] == 0.0 and emd[2] == 0.0
+    assert ks[3] == 0.0 and jsd[3] == 0.0 and emd[3] == 0.0
+
+
+def test_divergence_scores_bank_smaller_than_live_rows():
+    # the accumulator grew past the bank between carry growth points:
+    # rows past the bank's high-water are cold -> exactly 0
+    b = 8
+    bins = np.full((6, b), 10, dtype=np.int32)
+    cdf = jnp.asarray(np.cumsum(bins, axis=1, dtype=np.int32))
+    counts = jnp.asarray(bins.sum(axis=1).astype(np.int32))
+    prof = jnp.asarray(np.full((1, 3, b), 1.0 / b, dtype=np.float32))
+    wsum = jnp.asarray(np.ones((1, 3), dtype=np.float32))
+    out = divergence_scores(cdf, counts, prof, wsum,
+                            np.int32(0), np.int32(1))
+    assert np.asarray(out["ks"]).shape == (6,)
+    assert (np.asarray(out["ks"])[3:] == 0.0).all()
+    # in-bank rows compare a uniform pmf against itself -> ~0
+    np.testing.assert_allclose(np.asarray(out["ks"])[:3], 0.0, atol=1e-6)
+
+
+def test_resolve_divergence_path_policy():
+    assert resolve_divergence_path("auto", "tpu", False) == "pallas"
+    assert resolve_divergence_path("auto", "tpu", True) == "jnp"
+    assert resolve_divergence_path("auto", "cpu", False) == "jnp"
+    assert resolve_divergence_path("jnp", "tpu", False) == "jnp"
+    with pytest.raises(ValueError):
+        resolve_divergence_path("pallas", "tpu", True)
+    with pytest.raises(ValueError):
+        resolve_divergence_path("warp", "cpu", False)
+
+
+def test_anomaly_config_validation():
+    with pytest.raises(ValueError):
+        AnomalyConfig(decay=1.0)
+    with pytest.raises(ValueError):
+        AnomalyConfig(banks=0)
+    with pytest.raises(ValueError):
+        # 0 would let the all-zero warmup histogram wash baselines
+        AnomalyConfig(min_samples=0)
+    assert hourly_bank(T0.replace(hour=17)) == 17
+
+
+# ---------------------------------------------------------------------- #
+# the dispatch-count guarantee (ISSUE acceptance: EWMA rides the fused
+# commit at zero extra dispatches; scoring adds AT MOST one)
+# ---------------------------------------------------------------------- #
+
+def test_drift_scoring_at_most_one_extra_dispatch():
+    committer, agg, wheel, am, _ = _pair()
+    calls = {"fused": 0, "snap": 0, "div": 0}
+    real_fused, real_snap, real_div = (
+        committer._fused, committer._fused_snap, am._div,
+    )
+    committer._fused = lambda *a: calls.__setitem__(
+        "fused", calls["fused"] + 1) or real_fused(*a)
+    committer._fused_snap = lambda *a: calls.__setitem__(
+        "snap", calls["snap"] + 1) or real_snap(*a)
+
+    def counting_div(*a):
+        calls["div"] += 1
+        return real_div(*a)
+    am._div = counting_div
+
+    for i in range(5):
+        mode = committer.commit(_raw(i, {"lat": UNIMODAL, "qps": {0: 99}}))
+        assert mode == "fused"
+        # the commit itself keeps its <= 2 dispatch guarantee: the EWMA
+        # update is INSIDE the final-chunk program, not a new launch
+        assert calls["fused"] + calls["snap"] <= 2
+        assert calls["snap"] == 1
+        assert committer.last_dispatches <= 2
+        # ... and drift scoring is exactly the one divergence dispatch
+        assert calls["div"] == 1
+        calls["fused"] = calls["snap"] = calls["div"] = 0
+    assert am.scored_intervals == 5
+
+
+def test_check_every_skips_scoring_dispatches():
+    committer, agg, wheel, am, _ = _pair(config=AnomalyConfig(
+        decay=0.8, min_samples=16, check_every=3,
+    ))
+    calls = {"div": 0}
+    real_div = am._div
+
+    def counting_div(*a):
+        calls["div"] += 1
+        return real_div(*a)
+    am._div = counting_div
+    for i in range(6):
+        committer.commit(_raw(i, {"lat": UNIMODAL}))
+    assert calls["div"] == 2  # intervals 3 and 6 only
+    assert am.scored_intervals == 2
+
+
+# ---------------------------------------------------------------------- #
+# the headline behavior: shape shift fires, rate shift does not
+# ---------------------------------------------------------------------- #
+
+def _drift_engine(threshold=0.05, stat="jsd"):
+    # a drift baseline adapts SLOWER than the live window rolls (decay
+    # 0.95 ~= 20-interval memory vs the 4-slot window) — otherwise the
+    # baseline absorbs a regression as fast as the window surfaces it
+    committer, agg, wheel, am, _ = _pair(config=AnomalyConfig(
+        decay=0.95, min_samples=16,
+    ))
+    engine = RuleEngine(wheel)
+    rule = DistributionDriftRule("lat_drift", "lat", stat=stat,
+                                 threshold=threshold)
+    rule.bind(am)
+    engine.add(rule)
+    return committer, am, engine, rule
+
+
+def test_bimodal_shift_at_flat_p50_fires_drift_alert():
+    committer, am, engine, rule = _drift_engine()
+    # establish the baseline: 6 unimodal intervals
+    for i in range(6):
+        committer.commit(_raw(i, {"lat": UNIMODAL}))
+        assert engine.evaluate(T0) == []
+    base = am.scores_for("lat")
+    assert base is not None and base["jsd"] < 1e-5
+
+    # the shape regresses bimodal while the MEDIAN stays put — the
+    # failure mode scalar p50 alerting is blind to.  4 intervals roll
+    # the whole (4, 1) window onto the new shape.
+    fired = []
+    for i in range(6, 10):
+        committer.commit(_raw(i, {"lat": BIMODAL}))
+        fired += engine.evaluate(T0)
+    assert [a.state for a in fired] == ["firing"]
+    assert fired[0].rule == "lat_drift"
+    s = am.scores_for("lat")
+    assert s["jsd"] > 0.05 and s["ks"] > 0.0 and s["emd"] > 0.0
+    assert engine.active() == ["lat_drift"]
+
+
+def test_pure_rate_change_does_not_fire_drift():
+    committer, am, engine, rule = _drift_engine()
+    for i in range(6):
+        committer.commit(_raw(i, {"lat": UNIMODAL}))
+        engine.evaluate(T0)
+    # 4x the traffic, identical shape: pmfs match, drift must stay 0
+    quad = {b: 4 * c for b, c in UNIMODAL.items()}
+    for i in range(6, 12):
+        committer.commit(_raw(i, {"lat": quad}))
+        assert engine.evaluate(T0) == []
+    s = am.scores_for("lat")
+    assert s is not None
+    assert s["jsd"] < 1e-5 and s["ks"] < 1e-5 and s["emd"] < 1e-3
+    assert engine.active() == []
+
+
+def test_drift_rule_resolves_when_shape_recovers():
+    committer, am, engine, rule = _drift_engine()
+    for i in range(6):
+        committer.commit(_raw(i, {"lat": UNIMODAL}))
+        engine.evaluate(T0)
+    for i in range(6, 10):
+        committer.commit(_raw(i, {"lat": BIMODAL}))
+        engine.evaluate(T0)
+    assert engine.active() == ["lat_drift"]
+    # recovery: the window rolls back onto the unimodal shape and the
+    # EWMA (decay 0.8) re-converges; scores fall below threshold
+    resolved = []
+    for i in range(10, 30):
+        committer.commit(_raw(i, {"lat": UNIMODAL}))
+        resolved += engine.evaluate(T0)
+        if resolved:
+            break
+    assert [a.state for a in resolved] == ["resolved"]
+    assert engine.active() == []
+
+
+def test_unbound_drift_rule_never_breaches():
+    rule = DistributionDriftRule("d", "lat")
+    assert rule.evaluate(None, T0) is None
+    with pytest.raises(ValueError):
+        DistributionDriftRule("d", "lat", stat="psi")
+
+
+# ---------------------------------------------------------------------- #
+# multi-bank seasonality
+# ---------------------------------------------------------------------- #
+
+def test_bank_of_routes_updates_to_the_active_bank():
+    committer, agg, wheel, am, _ = _pair(config=AnomalyConfig(
+        banks=2, bank_of=lambda t: t.hour, decay=0.5, min_samples=16,
+    ))
+    # hour 0 traffic is unimodal, hour 1 traffic is bimodal; each bank
+    # learns only its own hour
+    for i in range(4):
+        committer.commit(_raw(i, {"lat": UNIMODAL}))
+    h1 = T0 + dt.timedelta(hours=1)
+    for i in range(4):
+        committer.commit(RawMetricSet(
+            time=h1 + dt.timedelta(seconds=i), counters={}, rates={},
+            histograms={"lat": BIMODAL}, gauges={}, duration=1.0,
+        ))
+    mid = agg.registry.lookup("lat")
+    prof = np.asarray(am._prof)
+    wsum = np.asarray(am._wsum)
+    assert wsum[0, mid] > 0 and wsum[1, mid] > 0
+    b0 = prof[0, mid] / wsum[0, mid]
+    b1 = prof[1, mid] / wsum[1, mid]
+    total = sum(UNIMODAL.values())
+    # bank 0 holds the unimodal pmf exactly (constant-input EWMA)
+    assert b0.max() == pytest.approx(UNIMODAL[100] / total, rel=1e-5)
+    # bank 1 learned a different shape: mass where bank 0 has none
+    assert (b1 > 0).sum() > (b0 > 0).sum()
+    # ... and the last scoring pass compared against hour-1's own bank,
+    # so steady bimodal traffic at hour 1 is NOT drift
+    s = am.scores_for("lat")
+    assert s is not None and s["jsd"] < 0.05
+
+
+# ---------------------------------------------------------------------- #
+# generation-keyed serving: dead/reused/compacted ids (satellite 2)
+# ---------------------------------------------------------------------- #
+
+def _churn_pair():
+    return _pair(lifecycle=LifecycleConfig(
+        check_every=1000, auto_compact_fragmentation=0.0,
+    ))
+
+
+def test_evicted_id_never_serves_drift_score():
+    committer, agg, wheel, am, lc = _churn_pair()
+    for i in range(4):
+        committer.commit(_raw(i, {"api.a": UNIMODAL, "api.b": UNIMODAL}))
+    assert am.scores_for("api.a") is not None
+    assert am.scores_for("api.b") is not None
+    bid = agg.registry.lookup("api.b")
+
+    lc.evict_ids([bid])
+
+    # the dead name resolves nowhere; the survivor's scores are ALSO
+    # withheld (generation moved) rather than served at stale row ids
+    assert am.scores_for("api.b") is None
+    assert am.scores_for("api.a") is None
+
+    # the victim's bank rows were zeroed inside the eviction critical
+    # section — the next tenant of that slot starts cold
+    assert (np.asarray(am._prof)[:, bid] == 0).all()
+    assert (np.asarray(am._wsum)[:, bid] == 0).all()
+    assert (np.asarray(am._ihist)[bid] == 0).all()
+
+    # a NEW series reusing the freed slot must not inherit b's baseline:
+    # its first scored interval is cold -> floored to exactly 0
+    committer.commit(_raw(4, {"api.a": UNIMODAL, "api.c": BIMODAL}))
+    assert agg.registry.lookup("api.c") == bid  # slot reused
+    s = am.scores_for("api.c")
+    assert s == {"ks": 0.0, "jsd": 0.0, "emd": 0.0}
+    # the survivor resumes serving after the re-score
+    assert am.scores_for("api.a") is not None
+
+
+def test_compaction_permutes_banks_and_invalidates_scores():
+    committer, agg, wheel, am, lc = _churn_pair()
+    names = [f"m{j}" for j in range(8)]
+    for i in range(5):
+        committer.commit(_raw(i, {n: UNIMODAL for n in names}))
+    mids = {n: agg.registry.lookup(n) for n in names}
+    pre_prof = np.asarray(am._prof)
+    pre_wsum = np.asarray(am._wsum)
+    victims = [mids[n] for n in names[::2]]
+    survivors = [n for j, n in enumerate(names) if j % 2]
+
+    lc.evict_ids(victims)
+    assert lc.compact() is True
+
+    # scores are withheld until the next pass re-scores the new layout
+    for n in names:
+        assert am.scores_for(n) is None
+
+    # survivor baselines followed the permutation bit-for-bit; freed
+    # tail rows came back cold
+    prof = np.asarray(am._prof)
+    wsum = np.asarray(am._wsum)
+    for n in survivors:
+        nid = agg.registry.lookup(n)
+        assert (prof[:, nid] == pre_prof[:, mids[n]]).all()
+        assert (wsum[:, nid] == pre_wsum[:, mids[n]]).all()
+    live = agg.registry.live_count()
+    assert (wsum[:, live:] == 0).all()
+
+    # and the engine keeps scoring cleanly on the repacked rows: steady
+    # survivors are still not drifting
+    committer.commit(_raw(50, {n: UNIMODAL for n in survivors}))
+    for n in survivors:
+        s = am.scores_for(n)
+        assert s is not None and s["jsd"] < 1e-5
+
+
+def test_device_failure_rebuilds_cold_banks():
+    committer, agg, wheel, am, _ = _pair()
+    for i in range(3):
+        committer.commit(_raw(i, {"lat": UNIMODAL}))
+    assert np.asarray(am._wsum).max() > 0
+    # simulate a failed donated dispatch: carries consumed, then the
+    # committer's failure hook runs
+    am._prof.delete()
+    am._ihist.delete()
+    with agg._dev_lock:
+        am.on_device_failure_locked()
+    assert am._prof is None and am._ihist is None
+    # the next commit rebuilds cold and keeps working; a below-floor
+    # interval leaves the rebuilt baseline unestablished, so scores are
+    # floored to exactly 0 — detection delayed, never wrong
+    committer.commit(_raw(3, {"lat": {0: 1}}))
+    s = am.scores_for("lat")
+    assert s == {"ks": 0.0, "jsd": 0.0, "emd": 0.0}
+    # and a full interval re-establishes the baseline from scratch
+    committer.commit(_raw(4, {"lat": BIMODAL}))
+    assert np.asarray(am._wsum).max() > 0
+
+
+# ---------------------------------------------------------------------- #
+# system wiring: facade, gauges, config errors
+# ---------------------------------------------------------------------- #
+
+def test_system_wiring_gauges_and_export():
+    from loghisto_tpu.system import TPUMetricSystem
+
+    ms = TPUMetricSystem(
+        interval=0.05, sys_stats=False, num_metrics=32,
+        retention=((8, 1),), commit="fused",
+        anomaly=AnomalyConfig(decay=0.8, min_samples=16,
+                              export_glob="api.*"),
+    )
+    try:
+        assert ms.anomaly is not None
+        assert ms.committer is not None and ms.committer.anomaly is ms.anomaly
+        rule = ms.add_rule(DistributionDriftRule("d", "api.lat"))
+        assert rule._manager is ms.anomaly
+        with ms._gauge_lock:
+            gauges = set(ms._gauge_funcs)
+        for g in ("anomaly.ScoredIntervals", "anomaly.SkippedIntervals",
+                  "anomaly.ExportedMetrics", "anomaly.Banks"):
+            assert g in gauges, g
+        # per-metric score gauges appear once a matching name is scored
+        ms.committer.commit(_raw(0, {"api.lat": UNIMODAL, "other": {0: 9}}))
+        with ms._gauge_lock:
+            gauges = set(ms._gauge_funcs)
+        for k in ("ks", "jsd", "emd"):
+            assert f"anomaly.api.lat.{k}" in gauges
+        assert "anomaly.other.ks" not in gauges  # glob filtered
+    finally:
+        ms.stop()
+
+
+def test_system_anomaly_requires_retention_and_fused():
+    from loghisto_tpu.system import TPUMetricSystem
+
+    with pytest.raises(ValueError, match="retention"):
+        TPUMetricSystem(sys_stats=False, anomaly=AnomalyConfig())
+    with pytest.raises(ValueError, match="fused"):
+        TPUMetricSystem(sys_stats=False, retention=((8, 1),),
+                        commit="fanout", anomaly=AnomalyConfig())
+    with pytest.raises(ValueError, match="drift"):
+        # drift rules without the drift engine fail loudly at add_rule
+        ms = TPUMetricSystem(sys_stats=False, retention=((8, 1),),
+                             commit="fused")
+        try:
+            ms.add_rule(DistributionDriftRule("d", "lat"))
+        finally:
+            ms.stop()

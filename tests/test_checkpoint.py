@@ -292,3 +292,83 @@ def test_lifecycle_roundtrip_generation_and_overflow(tmp_path):
     la2 = np.asarray(lc2._la)
     assert la2[reg2.lookup("api.a")] == 2
     assert la2[reg2.lookup("db.q")] == 1
+
+
+@pytest.mark.anomaly
+def test_anomaly_bank_roundtrip_remaps_by_name(tmp_path):
+    """ISSUE 7: drift baselines survive a restart — the EWMA banks are
+    checkpointed and restored through the same by-name row remap as the
+    activity vector, so a fresh process with a permuted registry still
+    scores each series against ITS OWN baseline."""
+    import datetime as dt
+
+    from loghisto_tpu.anomaly import AnomalyConfig, AnomalyManager
+    from loghisto_tpu.commit import IntervalCommitter
+    from loghisto_tpu.metrics import RawMetricSet
+    from loghisto_tpu.window import TimeWheel
+
+    cfg = MetricConfig(bucket_limit=64)
+
+    def build():
+        agg = TPUAggregator(num_metrics=16, config=cfg)
+        wheel = TimeWheel(num_metrics=16, config=cfg, interval=1.0,
+                          tiers=((4, 1),), registry=agg.registry)
+        am = AnomalyManager(agg, wheel, AnomalyConfig(
+            banks=2, bank_of=lambda t: t.hour, decay=0.9, min_samples=4,
+        ))
+        com = IntervalCommitter(agg, wheel, anomaly=am)
+        com.warmup()
+        return com, agg, am
+
+    def raw(i, hists):
+        return RawMetricSet(
+            time=dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+            + dt.timedelta(seconds=i),
+            counters={}, rates={}, histograms=hists, gauges={},
+            duration=1.0,
+        )
+
+    com, agg, am = build()
+    for i in range(4):
+        com.commit(raw(i, {"api.a": {1: 10}, "api.b": {5: 10}}))
+    mids = {n: agg.registry.lookup(n) for n in ("api.a", "api.b")}
+    prof0 = np.asarray(am._prof)
+    wsum0 = np.asarray(am._wsum)
+    assert wsum0[0, mids["api.a"]] > 0
+    scored = am.scored_intervals
+
+    path = str(tmp_path / "an.npz")
+    checkpoint.save(path, aggregator=agg, anomaly=am)
+
+    com2, agg2, am2 = build()
+    # occupy id 0 with a DIFFERENT name so the restore must remap by name
+    agg2._id_for("other")
+    checkpoint.restore(path, aggregator=agg2, anomaly=am2)
+    assert am2.scored_intervals == scored
+
+    reg2 = agg2.registry
+    prof2 = np.asarray(am2._prof)
+    wsum2 = np.asarray(am2._wsum)
+    for n, old in mids.items():
+        new = reg2.lookup(n)
+        assert new is not None and new != old  # actually remapped
+        assert (prof2[:, new] == prof0[:, old]).all()
+        assert (wsum2[:, new] == wsum0[:, old]).all()
+    # the interloper and every unnamed row came through cold
+    assert (wsum2[:, reg2.lookup("other")] == 0).all()
+
+    # restored baselines serve immediately: the same steady shape scores
+    # ~0 drift on the first post-restore interval
+    com2.commit(raw(10, {"api.a": {1: 10}, "api.b": {5: 10}}))
+    s = am2.scores_for("api.a")
+    assert s is not None and s["jsd"] < 1e-5
+
+    # bank-count mismatch is a config error, not silent corruption
+    am3 = AnomalyManager(
+        TPUAggregator(num_metrics=16, config=cfg),
+        TimeWheel(num_metrics=16, config=cfg, interval=1.0,
+                  tiers=((4, 1),)),
+        AnomalyConfig(banks=1, min_samples=4),
+    )
+    with pytest.raises(ValueError, match="banks"):
+        am3.load_state({"prof": prof0, "wsum": wsum0})

@@ -54,6 +54,29 @@ def test_percentile_queries_example_runs():
     assert "recompute fallbacks 0" in out
 
 
+def test_drift_alerts_example_runs():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "drift_alerts.py")],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    assert "backfilled 170 intervals" in out
+    # the shape regression fires DURING the cache-bug phase (ISSUE 7
+    # acceptance: bimodal at ~flat p50 pages)...
+    timeline = [ln for ln in out.splitlines() if "FIRING" in ln
+                or "RESOLVED" in ln]
+    assert any("cache bug" in ln and "FIRING   api_latency_shape" in ln
+               for ln in timeline)
+    # ...while the scalar p50 rule sleeps through the whole outage and
+    # the pure-rate phase never pages drift
+    assert not any("api_latency_p50" in ln for ln in timeline)
+    assert not any("4x traffic" in ln for ln in timeline)
+    assert "active alerts: none" in out
+    # the drift gauges ride the normal exporter pipeline
+    assert "anomaly.api.latency.jsd" in out
+
+
 def test_migrate_from_go_example_runs():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", "migrate_from_go.py")],

@@ -12,7 +12,7 @@ import time
 import pytest
 
 from loghisto_tpu import MetricSystem, ProcessedMetricSet
-from loghisto_tpu.graphite import graphite_protocol
+from loghisto_tpu.graphite import graphite_protocol, make_graphite_serializer
 from loghisto_tpu.opentsdb import opentsdb_protocol
 from loghisto_tpu.submitter import Submitter, new_submitter
 
@@ -38,6 +38,44 @@ def test_graphite_multiple_lines_and_prefix():
     lines = out.decode().splitlines()
     assert len(lines) == 2
     assert all(line.startswith("myapp.h.") for line in lines)
+
+
+def test_graphite_static_tags_line_format():
+    # Graphite 1.1 tagged-series form: ;key=value appended to the path,
+    # sorted by key — pinned byte-exact
+    out = graphite_protocol(
+        _pms({"a_b": 1.5}), prefix="app", hostname="h",
+        tags={"env": "prod", "dc": "us-east"},
+    )
+    ts = int(TS.timestamp())
+    assert out == f"app.h.a.b;dc=us-east;env=prod 1.500000 {ts}\n".encode()
+
+
+def test_graphite_default_wire_format_unchanged_by_tags_support():
+    # the no-tags default must stay byte-identical to the historical
+    # output (the regression the satellite task pins)
+    out = graphite_protocol(_pms({"put_latency_99.9": 45.2}), hostname="testhost")
+    ts = int(TS.timestamp())
+    assert out == f"cockroach.testhost.put.latency.99.9 45.200000 {ts}\n".encode()
+    bound = make_graphite_serializer(hostname="testhost")
+    assert bound(_pms({"put_latency_99.9": 45.2})) == out
+
+
+def test_graphite_serializer_factory_binds_prefix_and_tags():
+    ser = make_graphite_serializer(
+        prefix="svc", hostname="h", tags={"region": "eu"}
+    )
+    ts = int(TS.timestamp())
+    assert ser(_pms({"m": 2.0})) == f"svc.h.m;region=eu 2.000000 {ts}\n".encode()
+
+
+def test_graphite_rejects_malformed_tags():
+    with pytest.raises(ValueError):
+        graphite_protocol(_pms({"m": 1.0}), tags={"bad;key": "v"})
+    with pytest.raises(ValueError):
+        make_graphite_serializer(tags={"k": "a;b"})
+    with pytest.raises(ValueError):
+        make_graphite_serializer(tags={"": "v"})
 
 
 def test_opentsdb_wire_format():

@@ -1,0 +1,60 @@
+"""CI hygiene: every ``pytest.mark.<name>`` used under tests/ must be
+declared in pyproject.toml's ``[tool.pytest.ini_options] markers`` list.
+An undeclared marker silently deselects nothing (and ``-m`` filters
+silently match nothing), so suite-splitting tiers rot without anyone
+noticing — this audit turns that into a hard failure."""
+
+import re
+from pathlib import Path
+
+# pytest's own marks: built in, never declared in pyproject
+_BUILTIN = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures", "filterwarnings",
+}
+
+_ROOT = Path(__file__).resolve().parent.parent
+_MARK_RE = re.compile(r"pytest\.mark\.([A-Za-z_][A-Za-z0-9_]*)")
+
+
+def _declared_markers():
+    text = (_ROOT / "pyproject.toml").read_text()
+    try:
+        import tomllib
+        data = tomllib.loads(text)
+        entries = data["tool"]["pytest"]["ini_options"]["markers"]
+    except ModuleNotFoundError:  # pragma: no cover - py310 fallback
+        block = re.search(r"markers\s*=\s*\[(.*?)\]", text, re.S).group(1)
+        entries = re.findall(r'"([^"]+)"', block)
+    return {e.split(":", 1)[0].strip() for e in entries}
+
+
+def _used_markers():
+    used = {}
+    for path in sorted((_ROOT / "tests").glob("**/*.py")):
+        for name in _MARK_RE.findall(path.read_text()):
+            if name not in _BUILTIN:
+                used.setdefault(name, path.name)
+    return used
+
+
+def test_every_used_marker_is_declared():
+    declared = _declared_markers()
+    assert declared, "no markers declared in pyproject.toml?"
+    used = _used_markers()
+    assert used, "marker scan found nothing — regex or layout broke"
+    undeclared = {n: f for n, f in used.items() if n not in declared}
+    assert not undeclared, (
+        "markers used but not declared in pyproject.toml "
+        f"[tool.pytest.ini_options]: {undeclared}"
+    )
+
+
+def test_subsystem_markers_are_in_use():
+    # the tier-marker map the roadmap's commands rely on; a renamed or
+    # deleted marker must update pyproject AND this pin together.
+    # ("slow" is declared for the tier-1 `-m 'not slow'` filter and may
+    # legitimately have no carriers at any given time.)
+    used = set(_used_markers())
+    for marker in ("window", "commit", "query", "lifecycle",
+                   "ingest_transport", "anomaly"):
+        assert marker in used, f"declared marker {marker!r} now unused"

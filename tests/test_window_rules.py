@@ -171,6 +171,90 @@ def test_alert_channel_delivery_and_eviction():
     engine.unsubscribe(ok)
 
 
+def test_resolve_path_hysteresis():
+    """The resolve-path state machine (previously only the firing path
+    was pinned): a single non-breach resolves AND resets the streak, so
+    re-firing pays the full for_intervals debounce again; repeated
+    non-breach evaluations emit RESOLVED exactly once."""
+    wheel = _wheel()
+    engine = RuleEngine(wheel)
+    rule = ThresholdRule("flappy", "lat", "avg", window=1.0,
+                         threshold=100.0, for_intervals=3)
+    engine.add(rule)
+
+    def push_eval(v):
+        wheel.push(_raw(push_eval.i, {"lat": [v]}))
+        push_eval.i += 1
+        return engine.evaluate(T0)
+    push_eval.i = 0
+
+    # two breaches, then a dip: the streak resets BEFORE the rule ever
+    # fired, so nothing is emitted on the dip (no phantom resolve)
+    assert push_eval(500.0) == [] and push_eval(500.0) == []
+    assert push_eval(1.0) == []
+    assert rule._streak == 0 and not rule.firing
+    # the two pre-dip breaches must not count toward the new streak
+    assert push_eval(500.0) == [] and push_eval(500.0) == []
+    assert [a.state for a in push_eval(500.0)] == [FIRING]
+    # one good interval resolves immediately (resolve has NO debounce)
+    assert [a.state for a in push_eval(1.0)] == [RESOLVED]
+    # further good intervals are quiet — RESOLVED is edge-triggered
+    assert push_eval(1.0) == [] and push_eval(1.0) == []
+    # and re-firing pays the full debounce again
+    assert push_eval(500.0) == [] and push_eval(500.0) == []
+    assert [a.state for a in push_eval(500.0)] == [FIRING]
+    states = [a.state for a in engine.history]
+    assert states == [FIRING, RESOLVED, FIRING]
+
+
+def test_slow_subscriber_strike_accounting():
+    """Alert-channel 2-strike eviction under a SLOW (but live)
+    subscriber: a failed offer earns a strike, a successful one resets
+    the count to zero — only two CONSECUTIVE failures evict.  A
+    subscriber that drains between alerts survives indefinitely."""
+    wheel = _wheel()
+    engine = RuleEngine(wheel)
+    engine.add(ThresholdRule("hot", "lat", "avg", 1.0, 10.0))
+    slow = Channel(capacity=1)
+    engine.subscribe(slow)
+
+    def flip(i, hot):
+        wheel.push(_raw(i, {"lat": [100.0 if hot else 1.0]}))
+        engine.evaluate(T0)
+
+    flip(0, True)    # FIRING delivered (queue now full)
+    flip(1, False)   # RESOLVED dropped -> strike 1
+    assert not slow.closed and slow in engine._subscribers
+    assert engine._subscribers[slow] == 1
+    # the slow consumer catches up; the next delivery succeeds and
+    # RESETS the strike count — strikes are consecutive, not lifetime
+    assert slow.get(block=False).state == FIRING
+    flip(2, True)    # FIRING delivered
+    assert engine._subscribers[slow] == 0
+    assert slow.get(block=False).state == FIRING
+    # stall again long enough for two consecutive drops: evicted+closed
+    flip(3, False)   # RESOLVED delivered (queue full again)
+    flip(4, True)    # dropped -> strike 1
+    flip(5, False)   # dropped -> strike 2 -> evicted
+    assert slow not in engine._subscribers
+    assert slow.closed
+    # the engine keeps evaluating fine with no subscribers
+    flip(6, True)
+    assert engine.active() == ["hot"]
+
+
+def test_closed_subscriber_evicted_immediately():
+    wheel = _wheel()
+    engine = RuleEngine(wheel)
+    engine.add(ThresholdRule("hot", "lat", "avg", 1.0, 10.0))
+    ch = Channel(capacity=4)
+    engine.subscribe(ch)
+    ch.close()
+    wheel.push(_raw(0, {"lat": [100.0]}))
+    engine.evaluate(T0)
+    assert ch not in engine._subscribers
+
+
 def test_duplicate_rule_name_rejected():
     engine = RuleEngine(_wheel())
     engine.add(ThresholdRule("a", "m", "avg", 1.0, 1.0))
