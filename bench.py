@@ -488,6 +488,54 @@ def main() -> None:
         print(f"bench: anomaly stage failed: {e}", file=sys.stderr)
     ready5.set()
 
+    # mesh-sharded fused commit headline (benchmarks/mesh_scale.py has
+    # the full shape grid): sharded fused dispatches/interval and
+    # committed samples/s vs the single-device fused path.  Runs in a
+    # SUBPROCESS: the 8-virtual-device CPU mesh needs XLA_FLAGS set
+    # before jax imports, which this process can no longer do.
+    ready6 = _start_watchdog(360.0, on_timeout=lambda: print(
+        json.dumps(result), flush=True
+    ))
+    try:
+        import subprocess
+
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "mesh_scale.py"),
+             "--commit-only", "--commit-reps", "5"],
+            capture_output=True, text=True, timeout=330.0,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"mesh_scale subprocess rc={proc.returncode}: "
+                f"{proc.stderr[-500:]}"
+            )
+        shapes = json.loads(proc.stdout)["commit"]["shapes"]
+        sharded = {
+            k: v for k, v in shapes.items()
+            if k != "single" and not v["suspect"]
+        }
+        if sharded:
+            best_key = max(
+                sharded, key=lambda k: sharded[k]["measured_samples_per_s"]
+            )
+            line = sharded[best_key]
+            result["mesh_commit_shape"] = best_key
+            result["mesh_commit_dispatches_per_interval"] = (
+                line["fused_dispatches_per_interval"]
+            )
+            result["mesh_commit_samples_per_s"] = line["fused_samples_per_s"]
+            result["mesh_commit_vs_single_device"] = (
+                line["fused_vs_single_device"]
+            )
+            result["mesh_commit_fanout_over_fused"] = (
+                line["fanout_over_fused"]
+            )
+    except Exception as e:  # never let the extra metric kill the bench
+        print(f"bench: mesh-commit stage failed: {e}", file=sys.stderr)
+    ready6.set()
+
     print(json.dumps(result))
 
 

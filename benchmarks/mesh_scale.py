@@ -4,6 +4,17 @@ fold + psum merge over the stream axis + metric-sharded accumulate +
 stats — at 10k metrics x 8193 buckets with multi-million-sample batches,
 against the single-device step on the same workload.
 
+PR-8 adds the interval-commit contenders per mesh shape: the sharded
+FUSED committer (one shard_map donated-carry program per interval —
+cell deltas psum once over the stream axis, then acc fold + every
+tier's open-slot scatter execute shard-local on metric-row-sharded
+carries) against the FAN-OUT pipeline on the same sharded state
+(bridge-merge + per-tier scatters, what "auto" used to force under a
+mesh), plus the single-device fused baseline.  Interval-amortized:
+per-interval commit latency, dispatches/interval, and committed
+samples/s, with bench.py's HBM-roofline plausibility guard marking
+physically impossible rates suspect instead of reporting them.
+
 On the CI/CPU host the 8 "devices" are virtual
 (--xla_force_host_platform_device_count=8) and time-slice one core, so
 absolute samples/s is not a hardware number; the signal is the
@@ -13,8 +24,11 @@ out-of-shard samples) from the kernel itself.  On a real multi-chip TPU
 the same harness reports true weak scaling (run with --tpu).
 
 Usage: python benchmarks/mesh_scale.py [--metrics 10000]
-       [--bucket-limit 4096] [--batch 4194304] [--reps 3] [--out FILE]
-Prints one JSON object; importable as ``run(...)`` for tests/capture.
+       [--bucket-limit 4096] [--batch 4194304] [--reps 3]
+       [--commit-only] [--commit-metrics 1024] [--commit-reps 8]
+       [--out FILE]
+Prints one JSON object (save as MESH_SCALE_r*.json); importable as
+``run(...)`` / ``run_commit(...)`` for tests/capture.
 """
 
 from __future__ import annotations
@@ -181,12 +195,207 @@ def run(num_metrics: int = 10_000, bucket_limit: int = 4_096,
     return result
 
 
+def _commit_intervals(rng, n, num_metrics, bucket_limit,
+                      cells_per_metric=24):
+    """Pre-built sparse interval payloads — identical streams for every
+    contender (mirrors benchmarks/interval_commit.py)."""
+    import datetime as _dt
+
+    t0 = _dt.datetime(2026, 1, 1, tzinfo=_dt.timezone.utc)
+    names = [f"m{i}" for i in range(num_metrics)]
+    out = []
+    for i in range(n):
+        hists = {}
+        for name in names:
+            b = rng.integers(-bucket_limit, bucket_limit, cells_per_metric)
+            c = rng.integers(1, 100, cells_per_metric)
+            h = {}
+            for bb, cc in zip(b, c):
+                h[int(bb)] = h.get(int(bb), 0) + int(cc)
+            hists[name] = h
+        out.append((t0 + _dt.timedelta(seconds=i), hists))
+    return out
+
+
+def run_commit(num_metrics: int = 1024, bucket_limit: int = 512,
+               reps: int = 8, tiers=((8, 1), (4, 8)),
+               shapes: list[dict] | None = None) -> dict:
+    """Fused-vs-fanout interval commit per mesh shape, interval-amortized.
+
+    Every contender is fed the identical interval stream; latency is a
+    host-blocking measure (block_until_ready on acc + every ring after
+    each interval) so async dispatch cannot flatter either side.
+    """
+    import jax
+
+    from bench import HBM_PEAK_BYTES_PER_S
+    from loghisto_tpu.commit import IntervalCommitter
+    from loghisto_tpu.config import MetricConfig
+    from loghisto_tpu.metrics import RawMetricSet
+    from loghisto_tpu.parallel.aggregator import TPUAggregator
+    from loghisto_tpu.parallel.mesh import make_mesh
+    from loghisto_tpu.window import TimeWheel
+    from loghisto_tpu.window import store as store_mod
+
+    platform = jax.devices()[0].platform
+    cap = HBM_PEAK_BYTES_PER_S.get(platform, 4e12)
+    cfg = MetricConfig(bucket_limit=bucket_limit)
+    rng = np.random.default_rng(0)
+    stream = _commit_intervals(rng, reps + 2, num_metrics, bucket_limit)
+    samples_per_interval = sum(
+        sum(h.values()) for h in stream[2][1].values()
+    )
+
+    def raw_of(entry):
+        t, hists = entry
+        return RawMetricSet(time=t, counters={}, rates={},
+                            histograms=hists, gauges={}, duration=1.0)
+
+    def block(agg, wheel):
+        agg._acc.block_until_ready()
+        for t in wheel._tiers:
+            t.ring.block_until_ready()
+
+    def timed_fused(mesh):
+        agg = TPUAggregator(num_metrics=num_metrics, config=cfg, mesh=mesh)
+        wheel = TimeWheel(num_metrics=num_metrics, config=cfg, interval=1.0,
+                          tiers=tiers, registry=agg.registry, mesh=mesh)
+        committer = IntervalCommitter(agg, wheel)
+        committer.warmup()
+        committer.commit(raw_of(stream[0]))  # warm name resolution
+        block(agg, wheel)
+        times, dispatches = [], []
+        for entry in stream[2:]:
+            raw = raw_of(entry)
+            t1 = time.perf_counter()
+            committer.commit(raw)
+            block(agg, wheel)
+            times.append(time.perf_counter() - t1)
+            dispatches.append(committer.last_dispatches)
+        assert committer.fanout_intervals == 0
+        return float(np.median(times)), int(np.median(dispatches))
+
+    def timed_fanout(mesh):
+        agg = TPUAggregator(num_metrics=num_metrics, config=cfg, mesh=mesh)
+        wheel = TimeWheel(num_metrics=num_metrics, config=cfg, interval=1.0,
+                          tiers=tiers, registry=agg.registry, mesh=mesh)
+        agg._bridge_warmup()
+        agg.merge_raw(raw_of(stream[0]))
+        wheel.push(raw_of(stream[0]))
+        block(agg, wheel)
+        counts = {"n": 0}
+        real_scatter = store_mod._scatter_cells_jit
+        real_open = store_mod._open_slot_jit
+        real_weighted = agg._weighted_ingest
+
+        def counting(fn):
+            def wrapped(*a, **kw):
+                counts["n"] += 1
+                return fn(*a, **kw)
+            return wrapped
+
+        store_mod._scatter_cells_jit = counting(real_scatter)
+        store_mod._open_slot_jit = counting(real_open)
+        agg._weighted_ingest = counting(real_weighted)
+        times, dispatches = [], []
+        try:
+            for entry in stream[2:]:
+                raw = raw_of(entry)
+                counts["n"] = 0
+                t1 = time.perf_counter()
+                agg.merge_raw(raw)
+                wheel.push(raw)
+                block(agg, wheel)
+                times.append(time.perf_counter() - t1)
+                dispatches.append(counts["n"])
+        finally:
+            store_mod._scatter_cells_jit = real_scatter
+            store_mod._open_slot_jit = real_open
+            agg._weighted_ingest = real_weighted
+        return float(np.median(times)), int(np.median(dispatches))
+
+    n = len(jax.devices())
+    if shapes is None:
+        shapes = []
+        metric = 1
+        while metric <= n:
+            if n % metric == 0 and num_metrics % metric == 0:
+                shapes.append({"stream": n // metric, "metric": metric})
+            metric *= 2
+
+    result = {
+        "metric": "mesh-sharded fused commit vs fan-out, per mesh shape",
+        "platform": platform,
+        "n_devices": n,
+        "num_metrics": num_metrics,
+        "num_buckets": cfg.num_buckets,
+        "tiers": [list(t) for t in tiers],
+        "reps": reps,
+        "samples_per_interval": samples_per_interval,
+        "hbm_peak_bytes_per_s": cap,
+        "shapes": {},
+    }
+
+    def entry(fused, fanout, t_single_fused=None):
+        fused_med, fused_disp = fused
+        fan_med, fan_disp = fanout
+        samples_per_s = samples_per_interval / max(fused_med, 1e-9)
+        # roofline guard: every committed sample is at minimum one
+        # int32 RMW (8 bytes); a rate above peak-bandwidth/8 means the
+        # timing broke, not that the program is fast
+        suspect = samples_per_s > cap / 8
+        out = {
+            "fused_commit_median_us": round(fused_med * 1e6, 1),
+            "fanout_commit_median_us": round(fan_med * 1e6, 1),
+            "fused_dispatches_per_interval": fused_disp,
+            "fanout_dispatches_per_interval": fan_disp,
+            "fused_samples_per_s": (
+                None if suspect else round(samples_per_s, 1)
+            ),
+            "measured_samples_per_s": round(samples_per_s, 1),
+            "suspect": suspect,
+            "fanout_over_fused": (
+                None if suspect
+                else round(fan_med / max(fused_med, 1e-9), 2)
+            ),
+        }
+        if suspect:
+            print(
+                f"mesh_scale: {samples_per_s:.3e} committed samples/s "
+                f"exceeds the {platform} roofline cap {cap / 8:.3e}; "
+                "withholding the headline for this shape",
+                file=sys.stderr,
+            )
+        if t_single_fused is not None:
+            out["fused_vs_single_device"] = round(
+                fused_med / max(t_single_fused, 1e-9), 3
+            )
+        return out
+
+    single_fused = timed_fused(None)
+    result["shapes"]["single"] = entry(single_fused, timed_fanout(None))
+    for shape in shapes:
+        mesh = make_mesh(stream=shape["stream"], metric=shape["metric"])
+        key = f"stream{shape['stream']}xmetric{shape['metric']}"
+        result["shapes"][key] = entry(
+            timed_fused(mesh), timed_fanout(mesh),
+            t_single_fused=single_fused[0],
+        )
+    return result
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--metrics", type=int, default=10_000)
     parser.add_argument("--bucket-limit", type=int, default=4_096)
     parser.add_argument("--batch", type=int, default=1 << 22)
     parser.add_argument("--reps", type=int, default=3)
+    parser.add_argument("--commit-only", action="store_true",
+                        help="skip the distributed-step sweep and report "
+                             "only the interval-commit contenders")
+    parser.add_argument("--commit-metrics", type=int, default=1024)
+    parser.add_argument("--commit-bucket-limit", type=int, default=512)
+    parser.add_argument("--commit-reps", type=int, default=8)
     parser.add_argument("--tpu", action="store_true",
                         help="keep the configured (TPU) platform instead "
                              "of forcing virtual-CPU devices")
@@ -197,8 +406,16 @@ def main(argv=None) -> int:
 
     if not args.tpu:
         jax.config.update("jax_platforms", "cpu")
-    result = run(num_metrics=args.metrics, bucket_limit=args.bucket_limit,
-                 batch=args.batch, reps=args.reps)
+    result = {}
+    if not args.commit_only:
+        result = run(num_metrics=args.metrics,
+                     bucket_limit=args.bucket_limit,
+                     batch=args.batch, reps=args.reps)
+    result["commit"] = run_commit(
+        num_metrics=args.commit_metrics,
+        bucket_limit=args.commit_bucket_limit,
+        reps=args.commit_reps,
+    )
     text = json.dumps(result, indent=1)
     print(text)
     if args.out:

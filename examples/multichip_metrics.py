@@ -1,0 +1,172 @@
+"""The full interval pipeline on a multi-device mesh, end to end:
+mesh-sharded fused commit + lifecycle eviction + distribution drift
+alerting + percentile serving, all on `("stream", "metric")`-sharded
+carries.
+
+The scenario: an API fleet reports `api.latency` (steady, drifting in
+shape halfway through) alongside per-request-id debug series that churn
+every interval.  On one chip this is ISSUE-4 + ISSUE-7 territory; here
+the state is sharded over an 8-device mesh and `commit="auto"` resolves
+to the SHARDED fused path — one `shard_map` program per interval that
+psums the cell deltas over the stream axis once, then folds the
+accumulator, every retention tier, the activity stamps, and the EWMA
+baseline banks shard-local on metric-row-sharded carries:
+
+  * lifecycle: churned `req.<n>.trace` names are TTL-evicted into a
+    count-exact overflow row, bounding device memory by LIVE series —
+    victim decisions on host, fold-evict on the sharded carries;
+  * drift: the latency distribution goes bimodal at ~flat p50 and the
+    `distribution_drift` rule pages off the shard-local-maintained
+    baselines;
+  * queries: percentiles serve from the still-sharded snapshot views —
+    the gather ships only the requested rows from their owning shard.
+
+Runs anywhere: the 8 "devices" are virtual CPU devices
+(--xla_force_host_platform_device_count=8), the same mechanism CI uses
+to execute the real shard_map/psum programs without TPU hardware."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# must precede the jax import: the CPU backend decides its device count
+# at initialization
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import datetime as dt
+
+import numpy as np
+
+from loghisto_tpu import TPUMetricSystem
+from loghisto_tpu.anomaly import AnomalyConfig
+from loghisto_tpu.channel import Channel
+from loghisto_tpu.config import MetricConfig
+from loghisto_tpu.lifecycle import LifecycleConfig
+from loghisto_tpu.metrics import RawMetricSet
+from loghisto_tpu.ops.codec import compress_np
+from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS, make_mesh
+from loghisto_tpu.window import DistributionDriftRule
+
+mesh = make_mesh(stream=2, metric=4)
+print(f"== mesh: {mesh.shape[STREAM_AXIS]} stream x "
+      f"{mesh.shape[METRIC_AXIS]} metric over "
+      f"{len(jax.devices())} devices ==")
+
+cfg = MetricConfig(bucket_limit=1024)
+ms = TPUMetricSystem(
+    interval=1.0, sys_stats=False, config=cfg, num_metrics=64, mesh=mesh,
+    retention=[(30, 1)], commit="auto",
+    # churn control: a debug series idle for 5 intervals is folded —
+    # count-exact — into _overflow.req and its device row freed
+    lifecycle=LifecycleConfig(ttl_intervals=5, check_every=2),
+    anomaly=AnomalyConfig(decay=0.99, min_samples=100, window=10.0),
+)
+print(f"== commit path: {ms.commit_path} (auto under the mesh) ==")
+assert ms.commit_path == "fused", "capability resolution should pick fused"
+
+ms.add_rule(DistributionDriftRule(
+    "api_latency_shape", "api.latency", stat="jsd", threshold=0.05,
+    for_intervals=3,
+))
+alerts = Channel(capacity=64)
+ms.subscribe_to_alerts(alerts)
+
+PHASES = (("healthy", 45), ("cache bug", 25), ("rollback", 50))
+T0 = dt.datetime(2026, 8, 5, tzinfo=dt.timezone.utc)
+
+
+def synthetic_intervals():
+    rng = np.random.default_rng(7)
+    i = 0
+    for phase, n in PHASES:
+        for _ in range(n):
+            requests = 1000
+            if phase == "cache bug":
+                misses = int(0.4 * requests)
+                lat_ms = np.concatenate([
+                    rng.lognormal(np.log(50.0), 0.25, requests - misses),
+                    rng.lognormal(np.log(400.0), 0.25, misses),
+                ])
+            else:
+                lat_ms = rng.lognormal(np.log(50.0), 0.25, requests)
+            ub, cnt = np.unique(compress_np(lat_ms, cfg.precision),
+                                return_counts=True)
+            hists = {"api.latency": {int(b): int(c)
+                                     for b, c in zip(ub, cnt)}}
+            # per-request debug traces: 3 fresh names per interval,
+            # never seen again — unbounded cardinality without lifecycle
+            for j in range(3):
+                hists[f"req.{i}_{j}.trace"] = {0: 5}
+            yield phase, RawMetricSet(
+                time=T0 + dt.timedelta(seconds=i), counters={},
+                rates={"api.requests": requests}, gauges={}, duration=1.0,
+                histograms=hists,
+            )
+            i += 1
+
+
+n = 0
+last_phase = None
+for phase, raw in synthetic_intervals():
+    if phase != last_phase:
+        print(f"== phase: {phase} ==")
+        last_phase = phase
+    n += ms.backfill_retention([raw])
+print(f"== backfilled {n} intervals through the sharded fused commit ==")
+
+# dispatch receipts: the sharded program kept the single-device budget
+c = ms.committer
+print(f"  fused intervals: {c.fused_intervals} of {c.intervals_committed} "
+      f"(last interval: {c.last_dispatches} dispatches, "
+      f"{c.last_uploads} upload)")
+assert c.last_dispatches <= 2 and c.fanout_intervals == 0
+
+# lifecycle receipts: cumulative names far exceed rows, memory bounded
+reg = ms.aggregator.registry
+lc = ms.lifecycle
+print(f"  lifecycle: {n * 3 + 1} cumulative names -> "
+      f"{reg.live_count()} live rows "
+      f"({lc.evicted_series} evicted, "
+      f"{lc.overflowed_samples} samples folded count-exact into overflow)")
+assert lc.evicted_series > 0
+assert ms.aggregator.num_metrics == 64  # never grew past the budget
+
+# the drift page fired during the cache bug and resolved after rollback
+def phase_of(t):
+    i = int((t - T0).total_seconds())
+    for phase, n_ in PHASES:
+        if i < n_:
+            return phase
+        i -= n_
+    return "?"
+
+
+print("== alert timeline ==")
+while len(alerts):
+    a = alerts.get(block=False)
+    print(f"  [{phase_of(a.time):9s}] {a.state.upper():8s} "
+          f"{a.rule}: {a.message}")
+
+# scores_for is generation-keyed: an eviction AFTER the last scoring
+# pass invalidates the vector rather than risk serving a reused row
+s = ms.anomaly.scores_for("api.latency") or {}
+q = ms.query_window("api.latency", window=10.0, percentiles=(0.5, 0.99))
+m = q.metrics["api.latency"]
+print("== final state (served from metric-row-sharded snapshots) ==")
+print(f"  api.latency p50={m['p50']:.0f}ms p99={m['p99']:.0f}ms")
+print(f"  drift scores: jsd={s.get('jsd', float('nan')):.3f} "
+      f"ks={s.get('ks', float('nan')):.3f}")
+print(f"  active alerts: {ms.rule_engine.active() or 'none'}")
+
+ms.stop()

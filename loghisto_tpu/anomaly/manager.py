@@ -48,6 +48,11 @@ from loghisto_tpu.ops.anomaly import (
     make_divergence_fn,
     resolve_divergence_path,
 )
+from loghisto_tpu.parallel.mesh import (
+    acc_sharding,
+    bank_weight_sharding,
+    ring_sharding,
+)
 
 logger = logging.getLogger("loghisto_tpu")
 
@@ -100,7 +105,15 @@ class AnomalyManager:
             wheel.pin_window(config.window)
 
         # donated device carries, guarded by aggregator._dev_lock like
-        # the accumulator/activity vector they commit beside
+        # the accumulator/activity vector they commit beside.  Under a
+        # mesh each carry is metric-row-sharded in the layout the
+        # sharded fused commit requires (parallel/mesh.py helpers)
+        mesh = aggregator.mesh
+        self._ihist_sharding = acc_sharding(mesh) if mesh is not None else None
+        self._prof_sharding = ring_sharding(mesh) if mesh is not None else None
+        self._wsum_sharding = (
+            bank_weight_sharding(mesh) if mesh is not None else None
+        )
         self._prof: Optional[jnp.ndarray] = None   # f32 [K, M, B]
         self._wsum: Optional[jnp.ndarray] = None   # f32 [K, M]
         self._ihist: Optional[jnp.ndarray] = None  # int32 [M, B]
@@ -145,6 +158,12 @@ class AnomalyManager:
 
     # -- carry protocol (callers hold aggregator._dev_lock) -------------- #
 
+    def _place(self, x: jnp.ndarray, sharding) -> jnp.ndarray:
+        """Pin a rebuilt/grown/restored carry to its mesh layout (no-op
+        single-device).  Row growth under a mesh happens in metric-axis
+        units, so the result always shards evenly."""
+        return x if sharding is None else jax.device_put(x, sharding)
+
     def ensure_capacity_locked(self, m: int):
         """The drift carries, padded to ``m`` rows (new rows start cold:
         zero profile, zero weight — they score 0 until their baseline
@@ -153,25 +172,32 @@ class AnomalyManager:
         k = self.config.banks
         b = self.wheel.config.num_buckets
         if self._ihist is None:
-            self._ihist = jnp.zeros((m, b), dtype=jnp.int32)
+            self._ihist = self._place(
+                jnp.zeros((m, b), dtype=jnp.int32), self._ihist_sharding
+            )
         elif self._ihist.shape[0] < m:
-            self._ihist = jnp.concatenate([
+            self._ihist = self._place(jnp.concatenate([
                 self._ihist,
                 jnp.zeros((m - self._ihist.shape[0], b), dtype=jnp.int32),
-            ])
+            ]), self._ihist_sharding)
         if self._prof is None:
-            self._prof = jnp.zeros((k, m, b), dtype=jnp.float32)
-            self._wsum = jnp.zeros((k, m), dtype=jnp.float32)
+            self._prof = self._place(
+                jnp.zeros((k, m, b), dtype=jnp.float32),
+                self._prof_sharding,
+            )
+            self._wsum = self._place(
+                jnp.zeros((k, m), dtype=jnp.float32), self._wsum_sharding
+            )
         elif self._prof.shape[1] < m:
             gap = m - self._prof.shape[1]
-            self._prof = jnp.concatenate([
+            self._prof = self._place(jnp.concatenate([
                 self._prof,
                 jnp.zeros((k, gap, b), dtype=jnp.float32),
-            ], axis=1)
-            self._wsum = jnp.concatenate([
+            ], axis=1), self._prof_sharding)
+            self._wsum = self._place(jnp.concatenate([
                 self._wsum,
                 jnp.zeros((k, gap), dtype=jnp.float32),
-            ], axis=1)
+            ], axis=1), self._wsum_sharding)
         return self._ihist, (self._prof, self._wsum)
 
     def store_carry_locked(self, ihist, banks) -> None:
@@ -318,10 +344,14 @@ class AnomalyManager:
                 f"checkpoint has {prof.shape[0]} banks, config has "
                 f"{self.config.banks}"
             )
+        # checkpoints carry host arrays; restore re-shards onto THIS
+        # manager's mesh layout, keeping checkpoints mesh-shape-portable
         with self.aggregator._dev_lock:
             if prof.shape[1]:
-                self._prof = jnp.asarray(prof)
-                self._wsum = jnp.asarray(wsum)
+                self._prof = self._place(jnp.asarray(prof),
+                                         self._prof_sharding)
+                self._wsum = self._place(jnp.asarray(wsum),
+                                         self._wsum_sharding)
         self.scored_intervals = int(state.get("scored_intervals", 0))
 
     # -- gauges ------------------------------------------------------------ #

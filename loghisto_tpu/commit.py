@@ -64,7 +64,10 @@ from loghisto_tpu.ops.commit import (
     CellStagingRing,
     make_fused_commit_fn,
     make_fused_commit_snapshot_fn,
+    make_sharded_fused_commit_fn,
+    make_sharded_fused_commit_snapshot_fn,
 )
+from loghisto_tpu.parallel.mesh import STREAM_AXIS, cell_sharding
 from loghisto_tpu.window.snapshot import AccSnapshot
 from loghisto_tpu.window.store import trailing_mask
 
@@ -88,6 +91,11 @@ def commit_incompatibility(aggregator, wheel) -> Optional[str]:
         return (
             f"precision mismatch (aggregator {aggregator.config.precision},"
             f" wheel {wheel.config.precision})"
+        )
+    if getattr(aggregator, "mesh", None) is not getattr(wheel, "mesh", None):
+        return (
+            "aggregator and wheel are sharded over different meshes (the "
+            "fused program's carries must share one row sharding)"
         )
     return None
 
@@ -130,16 +138,42 @@ class IntervalCommitter:
         self.anomaly = anomaly
         track = lifecycle is not None
         track_b = anomaly is not None
-        self._fused = make_fused_commit_fn(len(wheel._tiers), track,
-                                           track_b)
-        # final-chunk variant: same fold + the query engine's snapshot
-        # emission (per-tier window CDFs + the acc CDF) in ONE dispatch
-        self._fused_snap = make_fused_commit_snapshot_fn(
-            len(wheel._tiers), wheel.config.bucket_limit,
-            wheel.config.precision, wheel.merge_path,
-            track_activity=track, track_baseline=track_b,
-        )
-        self._staging = CellStagingRing(depth=staging_depth, width=self.chunk)
+        self.mesh = getattr(aggregator, "mesh", None)
+        staging_sharding = None
+        if self.mesh is not None:
+            # sharded fused path: identical operand protocol, but the
+            # program runs under shard_map — staged cells arrive
+            # stream-sharded and ONE psum per chunk merges the deltas
+            # before the shard-local carry updates
+            n_stream = self.mesh.shape[STREAM_AXIS]
+            if self.chunk % n_stream:
+                raise ValueError(
+                    f"commit chunk {self.chunk} not divisible by the mesh "
+                    f"stream axis ({n_stream}): staged cell chunks always "
+                    "pad to the full width, which must split evenly"
+                )
+            self._fused = make_sharded_fused_commit_fn(
+                self.mesh, len(wheel._tiers), track, track_b
+            )
+            self._fused_snap = make_sharded_fused_commit_snapshot_fn(
+                self.mesh, len(wheel._tiers), wheel.config.bucket_limit,
+                wheel.config.precision, wheel.merge_path,
+                track_activity=track, track_baseline=track_b,
+            )
+            staging_sharding = cell_sharding(self.mesh)
+        else:
+            self._fused = make_fused_commit_fn(len(wheel._tiers), track,
+                                               track_b)
+            # final-chunk variant: same fold + the query engine's snapshot
+            # emission (per-tier window CDFs + the acc CDF) in ONE dispatch
+            self._fused_snap = make_fused_commit_snapshot_fn(
+                len(wheel._tiers), wheel.config.bucket_limit,
+                wheel.config.precision, wheel.merge_path,
+                track_activity=track, track_baseline=track_b,
+            )
+        self._staging = CellStagingRing(depth=staging_depth,
+                                        width=self.chunk,
+                                        sharding=staging_sharding)
 
         # self-metrics (ISSUE 2): per-interval dispatch/H2D accounting
         # plus a bounded latency reservoir for the percentile gauges

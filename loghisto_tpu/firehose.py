@@ -122,7 +122,7 @@ def make_mesh_firehose_interval_step(
 
     from loghisto_tpu.ops.dispatch import ingest_step_fn, resolve_ingest_path
     from loghisto_tpu.ops.ingest import sanitize_ids
-    from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
+    from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS, shard_map
 
     n_stream = mesh.shape[STREAM_AXIS]
     n_metric = mesh.shape[METRIC_AXIS]
@@ -147,7 +147,7 @@ def make_mesh_firehose_interval_step(
         )
         return folded[None]
 
-    ingest_inner = jax.shard_map(
+    ingest_inner = shard_map(
         local_ingest, mesh=mesh,
         in_specs=(P(STREAM_AXIS, METRIC_AXIS, None), P()),
         out_specs=P(STREAM_AXIS, METRIC_AXIS, None),
@@ -163,7 +163,7 @@ def make_mesh_firehose_interval_step(
         return acc_local + merged, jnp.zeros_like(partial_local)
 
     collect = jax.jit(
-        jax.shard_map(
+        shard_map(
             local_collect, mesh=mesh,
             in_specs=(
                 P(METRIC_AXIS, None),

@@ -48,6 +48,7 @@ from loghisto_tpu.ops.lifecycle import (
     pad_pow2_ids,
     resolve_compact_path,
 )
+from loghisto_tpu.parallel.mesh import row_vector_sharding
 
 logger = logging.getLogger("loghisto_tpu")
 
@@ -91,7 +92,13 @@ class LifecycleManager:
         self.anomaly = None
 
         # device activity vector; sized lazily to the accumulator's row
-        # count (guarded by aggregator._dev_lock, like the accumulator)
+        # count (guarded by aggregator._dev_lock, like the accumulator).
+        # Under a mesh the carry is metric-row-sharded like the
+        # accumulator it shadows (the sharded fused commit requires it)
+        self._sharding = (
+            row_vector_sharding(aggregator.mesh)
+            if aggregator.mesh is not None else None
+        )
         self._la: Optional[jnp.ndarray] = None
 
         self._intervals_seen = 0
@@ -112,19 +119,30 @@ class LifecycleManager:
         journal replay keep activity comparisons meaningful for free."""
         return self.wheel.intervals_pushed
 
+    def _place(self, la: jnp.ndarray) -> jnp.ndarray:
+        """Pin a rebuilt/grown carry to its mesh sharding (no-op when
+        single-device).  Row growth under a mesh happens in metric-axis
+        units (TPUAggregator._grow_row_unit), so the result always
+        shards evenly."""
+        if self._sharding is None:
+            return la
+        return jax.device_put(la, self._sharding)
+
     def ensure_capacity_locked(self, m: int) -> jnp.ndarray:
         """The activity carry, padded to ``m`` rows (new rows stamp the
         current epoch: a freshly grown row is as alive as a fresh
         registration)."""
         la = self._la
         if la is None:
-            la = jnp.full((m,), np.int32(self.epoch), dtype=jnp.int32)
+            la = self._place(
+                jnp.full((m,), np.int32(self.epoch), dtype=jnp.int32)
+            )
         elif la.shape[0] < m:
-            la = jnp.concatenate([
+            la = self._place(jnp.concatenate([
                 la,
                 jnp.full((m - la.shape[0],), np.int32(self.epoch),
                          dtype=jnp.int32),
-            ])
+            ]))
         self._la = la
         return la
 
@@ -148,10 +166,10 @@ class LifecycleManager:
         never cause a wrong one."""
         la = self._la
         if la is not None and getattr(la, "is_deleted", lambda: False)():
-            self._la = jnp.full(
+            self._la = self._place(jnp.full(
                 (self.aggregator.num_metrics,), np.int32(self.epoch),
                 dtype=jnp.int32,
-            )
+            ))
 
     # -- the policy tick -------------------------------------------------- #
 
@@ -406,10 +424,13 @@ class LifecycleManager:
             }
 
     def load_state(self, state: dict) -> None:
+        # checkpoints carry host arrays, so restore re-shards onto THIS
+        # manager's mesh layout — checkpoints stay mesh-shape-portable
+        # (save on 2x4, restore on 1x8)
         la = np.asarray(state.get("last_active", []), dtype=np.int32)
         with self.aggregator._dev_lock:
             if len(la):
-                self._la = jnp.asarray(la)
+                self._la = self._place(jnp.asarray(la))
         with self._metrics_lock:
             self.evicted_series = int(state.get("evicted_series", 0))
             self.overflowed_samples = int(

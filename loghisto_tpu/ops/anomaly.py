@@ -25,6 +25,13 @@ the distribution back into alerting:
     start cold, never inherit the dead series' shape) and compaction
     applies the same survivor permutation as every other carry.
 
+Mesh-sharded state (PR 8): ``ewma_bank_update`` is row-elementwise, so
+the sharded fused commit calls it shard-local inside its ``shard_map``
+program on metric-row-sharded banks — same-order float ops per row,
+hence bit-identical to the single-device path.  The divergence scorer
+and the bank evict/compact programs jit over the sharded carries and
+let GSPMD place the (row-parallel) math; scores read back replicated.
+
 Divergence definitions, all in dense bucket space (axis index b = codec
 bucket b - bucket_limit; log buckets make one step ~= precision% in
 value space):

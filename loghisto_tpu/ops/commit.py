@@ -38,11 +38,15 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from loghisto_tpu.config import PRECISION
+from loghisto_tpu.ops.ingest import sanitize_ids
 from loghisto_tpu.ops.stats import dense_cdf
 from loghisto_tpu.ops.window import window_snapshot
+from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS, shard_map
 
 # Fixed commit launch width, matching the aggregator bridge's merge
 # chunk: one compiled executable serves every interval; a typical
@@ -254,6 +258,256 @@ def make_fused_commit_snapshot_fn(
     return commit
 
 
+def _shard_local_deltas(acc, rings, ids, idx, weights, track_activity):
+    """Shard-local body shared by the sharded commit factories: scatter
+    THIS stream-shard's cell slice into dense per-shard deltas, then run
+    ONE ``psum`` over the stream axis so every downstream consumer
+    (accumulator fold, tier scatters, activity stamp, interval
+    histogram) works shard-local on the merged interval delta.
+
+    Rows are translated to shard-local coordinates with the aggregator's
+    proven idiom (parallel/aggregator.py): ``ids - shard * local_rows``,
+    sanitized BEFORE the drop-mode scatter because JAX wraps negative
+    indices ahead of the bounds check.  Cells owned by other shards land
+    out of the local range and drop — exactly the single-device
+    ``mode="drop"`` semantics, applied per shard.
+
+    When a ring's row count differs from the accumulator's (registry
+    growth past the wheel's fixed rows), shard k of the ring covers
+    DIFFERENT global rows than shard k of the accumulator, so a second
+    delta is built at the ring width; all deltas (and the activity
+    touch-marker vector) merge in a single ``psum`` call, keeping the
+    collective count at one per dispatch.
+
+    Returns ``(acc_delta, {ring_rows: ring_delta}, touched_or_None)``.
+    """
+    shard = jax.lax.axis_index(METRIC_AXIS)
+    acc_rows = acc.shape[0]
+    acc_ids = sanitize_ids(ids - shard * acc_rows)
+    parts = {
+        "acc": jnp.zeros_like(acc).at[acc_ids, idx].add(weights,
+                                                        mode="drop")
+    }
+    ring_rows = sorted({r.shape[1] for r in rings} - {acc_rows})
+    for rows in ring_rows:
+        rids = sanitize_ids(ids - shard * rows)
+        parts[f"ring{rows}"] = (
+            jnp.zeros((rows, acc.shape[1]), acc.dtype)
+            .at[rids, idx].add(weights, mode="drop")
+        )
+    if track_activity:
+        # the single-device path stamps every in-range id, even at
+        # weight 0, so "delta != 0" is NOT a faithful activity signal;
+        # a psum'd touch-marker vector is exactly equivalent
+        parts["touched"] = (
+            jnp.zeros((acc_rows,), jnp.int32)
+            .at[acc_ids].max(1, mode="drop")
+        )
+    parts = jax.lax.psum(parts, STREAM_AXIS)
+    return (
+        parts["acc"],
+        {rows: parts[f"ring{rows}"] for rows in ring_rows},
+        parts.get("touched"),
+    )
+
+
+def _sharded_commit_specs(track_activity, track_baseline):
+    """(carry in/out specs, carry count) shared by both sharded
+    factories — the donated-carry prefix of the operand list."""
+    specs = [P(METRIC_AXIS, None), P(None, METRIC_AXIS, None)]
+    if track_activity:
+        specs.append(P(METRIC_AXIS))
+    if track_baseline:
+        specs.append(P(METRIC_AXIS, None))
+    return specs
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_fused_commit_fn(
+    mesh,
+    num_tiers: int,
+    track_activity: bool = False,
+    track_baseline: bool = False,
+):
+    """``make_fused_commit_fn`` for metric-row-sharded carries under the
+    ("stream", "metric") mesh: identical operand ordering, donation
+    ranges, and results (integer scatter-adds and the int32 psum are
+    order-independent, so the output is bit-identical to the
+    single-device fused path — tests/test_mesh_commit.py pins this).
+
+    The staged cell chunk arrives stream-sharded (``P(STREAM_AXIS)``,
+    see ``CellStagingRing``): each device scatters its slice into dense
+    shard-local deltas, ONE ``psum`` over the stream axis merges them,
+    and the accumulator fold, every tier's open-slot scatter, the
+    activity stamp, and the interval-histogram fold then execute
+    shard-local on the ``P(METRIC_AXIS)``-rowed carries — one collective
+    and one dispatch per chunk, preserving the <= 2-dispatches/interval
+    budget.  Cached per (mesh, tiers, flags); shape-polymorphic like the
+    single-device factory (per-shard row counts come from local operand
+    shapes), so registry growth never needs a new cache entry."""
+    donate = tuple(range(2 + int(track_activity) + int(track_baseline)))
+
+    def commit(*args):
+        it = iter(args)
+        acc = next(it)
+        rings = next(it)
+        last_active = next(it) if track_activity else None
+        ihist = next(it) if track_baseline else None
+        slots = next(it)
+        keeps = next(it)
+        ids = next(it)
+        idx = next(it)
+        weights = next(it)
+        epoch = next(it) if track_activity else None
+        ifirst = next(it) if track_baseline else None
+
+        delta, ring_deltas, touched = _shard_local_deltas(
+            acc, rings, ids, idx, weights, track_activity
+        )
+        acc = acc + delta
+        new_rings = []
+        for t in range(num_tiers):
+            ring = rings[t]
+            rd = ring_deltas.get(ring.shape[1], delta)
+            ring = ring.at[slots[t]].multiply(keeps[t], mode="drop")
+            ring = ring.at[slots[t]].add(rd, mode="drop")
+            new_rings.append(ring)
+        out = [acc, tuple(new_rings)]
+        if track_activity:
+            out.append(jnp.where(touched > 0,
+                                 jnp.maximum(last_active, epoch),
+                                 last_active))
+        if track_baseline:
+            out.append(ihist * ifirst + delta)
+        return tuple(out)
+
+    carry_specs = _sharded_commit_specs(track_activity, track_baseline)
+    in_specs = tuple(carry_specs) + (
+        P(), P(), P(STREAM_AXIS), P(STREAM_AXIS), P(STREAM_AXIS),
+    )
+    if track_activity:
+        in_specs += (P(),)      # epoch
+    if track_baseline:
+        in_specs += (P(),)      # ifirst
+    return jax.jit(
+        shard_map(
+            commit, mesh=mesh,
+            in_specs=in_specs, out_specs=tuple(carry_specs),
+        ),
+        donate_argnums=donate,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_fused_commit_snapshot_fn(
+    mesh,
+    num_tiers: int,
+    bucket_limit: int,
+    precision: int = PRECISION,
+    merge_path: str = "jnp",
+    track_activity: bool = False,
+    track_baseline: bool = False,
+):
+    """``make_fused_commit_snapshot_fn`` under the mesh: the sharded
+    fold of ``make_sharded_fused_commit_fn`` plus, in the SAME dispatch,
+    the EWMA baseline-bank decay and the snapshot emission — all
+    shard-local after the single stream psum, because every emitted
+    quantity (masked slot merge, row cumsum CDF, per-row sums matvec,
+    per-row EWMA decay) is row-independent.  Payload outputs keep the
+    metric-row sharding, so the published snapshot handle serves sparse
+    per-row gathers from the owning shard without replicating the CDF
+    tensors."""
+    if track_baseline:
+        # Deferred: ops.anomaly -> ops.lifecycle -> ops.commit cycle.
+        from loghisto_tpu.ops.anomaly import ewma_bank_update
+    donate = tuple(range(2 + int(track_activity) + 2 * int(track_baseline)))
+
+    def commit(*args):
+        it = iter(args)
+        acc = next(it)
+        rings = next(it)
+        last_active = next(it) if track_activity else None
+        ihist = next(it) if track_baseline else None
+        banks = next(it) if track_baseline else None
+        slots = next(it)
+        keeps = next(it)
+        ids = next(it)
+        idx = next(it)
+        weights = next(it)
+        epoch = next(it) if track_activity else None
+        masks = next(it)
+        if track_baseline:
+            ifirst = next(it)
+            bank = next(it)
+            decay = next(it)
+            min_count = next(it)
+
+        delta, ring_deltas, touched = _shard_local_deltas(
+            acc, rings, ids, idx, weights, track_activity
+        )
+        acc = acc + delta
+        new_rings = []
+        payloads = []
+        for t in range(num_tiers):
+            ring = rings[t]
+            rd = ring_deltas.get(ring.shape[1], delta)
+            ring = ring.at[slots[t]].multiply(keeps[t], mode="drop")
+            ring = ring.at[slots[t]].add(rd, mode="drop")
+            new_rings.append(ring)
+            payloads.append(
+                window_snapshot(ring, masks[t], bucket_limit, precision,
+                                merge_path)
+            )
+        out = [acc, tuple(new_rings)]
+        if track_activity:
+            out.append(jnp.where(touched > 0,
+                                 jnp.maximum(last_active, epoch),
+                                 last_active))
+        if track_baseline:
+            ihist = ihist * ifirst + delta
+            out.append(ihist)
+            out.append(ewma_bank_update(banks, ihist, bank, decay,
+                                        min_count))
+        acc_payload = dense_cdf(acc, bucket_limit, precision)
+        out.extend((tuple(payloads), acc_payload))
+        return tuple(out)
+
+    carry_specs = _sharded_commit_specs(track_activity, track_baseline)
+    bank_specs = (P(None, METRIC_AXIS, None), P(None, METRIC_AXIS))
+    in_specs = tuple(carry_specs)
+    if track_baseline:
+        in_specs += (bank_specs,)
+    in_specs += (P(), P(), P(STREAM_AXIS), P(STREAM_AXIS), P(STREAM_AXIS))
+    if track_activity:
+        in_specs += (P(),)      # epoch
+    in_specs += (P(),)          # masks (prefix broadcast over the tuple)
+    if track_baseline:
+        in_specs += (P(), P(), P(), P())  # ifirst, bank, decay, min_count
+    tier_payload_spec = {
+        "cdf": P(None, METRIC_AXIS, None),
+        "counts": P(None, METRIC_AXIS),
+        "sums": P(None, METRIC_AXIS),
+    }
+    acc_payload_spec = {
+        "cdf": P(METRIC_AXIS, None),
+        "counts": P(METRIC_AXIS),
+        "sums": P(METRIC_AXIS),
+    }
+    out_specs = tuple(carry_specs)
+    if track_baseline:
+        out_specs += (bank_specs,)
+    out_specs += (
+        tuple(dict(tier_payload_spec) for _ in range(num_tiers)),
+        acc_payload_spec,
+    )
+    return jax.jit(
+        shard_map(
+            commit, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        ),
+        donate_argnums=donate,
+    )
+
+
 class CellStagingRing:
     """Depth-D double-buffered H2D staging for interval cell arrays.
 
@@ -273,12 +527,17 @@ class CellStagingRing:
     H2D-bytes-per-interval gauge.
     """
 
-    def __init__(self, depth: int = 2, width: int = COMMIT_CHUNK):
+    def __init__(self, depth: int = 2, width: int = COMMIT_CHUNK,
+                 sharding=None):
         if depth < 2:
             raise ValueError("staging ring depth must be >= 2 (the "
                              "overlap contract needs one slot of slack)")
         self.depth = depth
         self.width = width
+        # under a mesh the cell chunk uploads stream-sharded (each
+        # device receives its slice of the staged pad arrays); the
+        # sharded commit programs consume it as P(STREAM_AXIS) operands
+        self.sharding = sharding
         self._slots = [
             (
                 np.empty(width, dtype=np.int32),
@@ -306,7 +565,11 @@ class CellStagingRing:
         hidx[n:] = 0
         hw[:n] = weights
         hw[n:] = 0
-        dev = jax.device_put((hid, hidx, hw))
+        dev = (
+            jax.device_put((hid, hidx, hw), self.sharding)
+            if self.sharding is not None
+            else jax.device_put((hid, hidx, hw))
+        )
         self.uploads += 1
         self.bytes_uploaded += 3 * self.width * 4
         return dev

@@ -271,7 +271,42 @@ def choose_transport(
     return "raw"
 
 
-def resolve_commit_path(path: str, platform: str, mesh: bool = False) -> str:
+def mesh_commit_incapability(mesh, num_metrics=None) -> str | None:
+    """Why a sharded configuration genuinely cannot run the fused
+    commit under ``shard_map``, as a human-readable reason string — or
+    None when it can (including ``mesh=None``: single-device state is
+    always capable).  The checks mirror what the sharded program
+    actually requires:
+
+      * the mesh must carry the ("stream", "metric") commit layout —
+        the program psums cell deltas over the stream axis and keeps
+        every carry metric-row-sharded;
+      * ``num_metrics`` (when known) must split evenly over the metric
+        axis, or the carries cannot take their ``P(metric)`` row
+        sharding at all.
+    """
+    if mesh is None:
+        return None
+    from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
+
+    axes = tuple(getattr(mesh, "axis_names", ()))
+    if STREAM_AXIS not in axes or METRIC_AXIS not in axes:
+        return (
+            f"mesh axes {axes!r} are not the ('{STREAM_AXIS}', "
+            f"'{METRIC_AXIS}') commit layout"
+        )
+    n_metric = mesh.shape[METRIC_AXIS]
+    if num_metrics is not None and num_metrics % n_metric:
+        return (
+            f"num_metrics={num_metrics} rows don't shard evenly over "
+            f"the {n_metric}-way metric axis"
+        )
+    return None
+
+
+def resolve_commit_path(
+    path: str, platform: str, mesh=None, num_metrics: int | None = None
+) -> str:
     """Resolve the interval-commit path: "fused" (one donated-carry
     program for the aggregator fold + every retention tier,
     ops/commit.py) or "fanout" (the per-consumer bridge-merge +
@@ -280,13 +315,19 @@ def resolve_commit_path(path: str, platform: str, mesh: bool = False) -> str:
     kernels, so a hardware capture retunes this with a committed JSON,
     not a code edit.
 
-    ``mesh=True`` marks sharded state (metric-row-sharded accumulator
-    and rings): auto stays on the fan-out there — a single program over
-    differently-sharded carries has not been hardware-validated, and the
-    fan-out's per-consumer programs carry known shardings.  Explicit
-    "fused" remains available as the opt-in."""
+    ``mesh`` takes the ("stream", "metric") mesh object when the state
+    is sharded (or None).  Resolution is capability-based, not a
+    blanket downgrade: sharded state runs the fused path under
+    ``shard_map`` unless ``mesh_commit_incapability`` reports a shape
+    that genuinely cannot shard (wrong axis layout, rows not divisible
+    by the metric axis) — "auto" then degrades to the fan-out, and an
+    explicit "fused" raises with the reason string.  A legacy boolean
+    ``mesh=True`` (no mesh object to inspect) is treated as a capable
+    sharded configuration."""
+    mesh_obj = None if isinstance(mesh, bool) or mesh is None else mesh
+    reason = mesh_commit_incapability(mesh_obj, num_metrics)
     if path == "auto":
-        if mesh:
+        if reason is not None:
             return "fanout"
         return "fused" if FUSED_COMMIT else "fanout"
     if path not in ("fused", "fanout"):
@@ -294,6 +335,8 @@ def resolve_commit_path(path: str, platform: str, mesh: bool = False) -> str:
             f"unknown commit path {path!r}: expected 'auto', 'fused', or "
             "'fanout'"
         )
+    if path == "fused" and reason is not None:
+        raise ValueError(f"fused commit unavailable on this mesh: {reason}")
     return path
 
 

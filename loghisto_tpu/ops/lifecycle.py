@@ -24,6 +24,17 @@ Out-of-range handling follows the house convention: DROP_ID pads
 (ops/commit.py) vanish via ``mode="drop"`` scatters and zero-fill
 gathers, so every program is shape-stable under jit — pad widths are
 pow-2 bucketed by the callers to bound executable counts.
+
+Mesh-sharded state (PR 8): these programs run unchanged on
+metric-row-sharded carries.  Victim decisions stay host-side (the
+manager gathers the activity vector, which is tiny), and the fold /
+compact programs jit over the sharded arrays — the victim gathers and
+permutation ``take``s address GLOBAL row ids, so GSPMD inserts the
+cross-shard collectives where a victim's overflow target lives on a
+different shard.  Only the per-interval hot path (the activity stamp
+inside the fused commit) is hand-placed under ``shard_map``
+(ops/commit.py); eviction and compaction are rare, so auto-partitioning
+is the right trade there.
 """
 
 from __future__ import annotations

@@ -345,16 +345,30 @@ def snapshot_row_stats(
 
 
 @functools.lru_cache(maxsize=None)
-def make_snapshot_query_fn(bucket_limit: int, precision: int = PRECISION):
+def make_snapshot_query_fn(
+    bucket_limit: int, precision: int = PRECISION, mesh=None
+):
     """Jitted sparse snapshot query ``f(cdf, counts, sums, ids, ps) ->
     stats for rows ids``: ONE gather + searchsorted dispatch, D2H
     traffic O(len(ids) * len(ps)).  Cached per bucket geometry so every
     wheel/aggregator with the same codec shares one jit object (and its
     per-shape executable cache — the plan cache's backing store); ids
     and ps are traced operands, so neither their values nor the commit
-    epoch ever retrace."""
+    epoch ever retrace.
 
-    @jax.jit
+    With ``mesh`` (metric-row-sharded snapshot views) the gather
+    partitions under GSPMD: each requested row ships from its owning
+    shard — sparse cross-shard traffic proportional to the matched ids,
+    never a full CDF replication — and the small ``[n, P]`` results are
+    pinned replicated so the host readback is a local copy on every
+    process."""
+    jit_kwargs = {}
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        jit_kwargs["out_shardings"] = NamedSharding(mesh, PartitionSpec())
+
+    @functools.partial(jax.jit, **jit_kwargs)
     def query(cdf, counts, sums, ids, ps):
         return snapshot_row_stats(
             cdf[ids], counts[ids], sums[ids], ps, bucket_limit, precision

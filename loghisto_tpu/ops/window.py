@@ -23,6 +23,12 @@ Two merge tiers:
 
 ``window_stats`` composes either merge with ops/stats.py ``dense_stats``
 into one jittable program: query(window) == one device dispatch.
+
+Mesh-sharded state (PR 8): ``window_snapshot`` additionally runs
+SHARD-LOCAL inside the sharded fused commit's ``shard_map`` program
+(ops/commit.py) — the masked ring-sum and CDF scan are row-independent,
+so each metric shard emits its own slice of the commit-time snapshot
+payloads with zero collectives beyond the one cell-delta psum.
 """
 
 from __future__ import annotations

@@ -44,7 +44,7 @@ from loghisto_tpu.ops.ingest import (
 )
 from loghisto_tpu.ops.dispatch import resolve_ingest_path
 from loghisto_tpu.ops.stats import dense_stats, dense_stats_np
-from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
+from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS, shard_map
 from loghisto_tpu.registry import MetricRegistry, RegistryFullError
 
 # Default registry-growth headroom: max_metrics = num_metrics * this when
@@ -241,7 +241,7 @@ def make_distributed_step(
         "sums": P(METRIC_AXIS),
         "percentiles": P(METRIC_AXIS, None),
     }
-    step = jax.shard_map(
+    step = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(METRIC_AXIS, None), P(STREAM_AXIS), P(STREAM_AXIS)),
@@ -253,10 +253,14 @@ def make_distributed_step(
 def make_sharded_accumulator(
     mesh: Mesh, num_metrics: int, num_buckets: int
 ) -> jnp.ndarray:
-    """Zero accumulator laid out metric-sharded, stream-replicated."""
-    sharding = NamedSharding(mesh, P(METRIC_AXIS, None))
+    """Zero accumulator laid out metric-sharded, stream-replicated
+    (the canonical acc layout from parallel.mesh, shared with the
+    sharded fused commit and checkpoint restore)."""
+    from loghisto_tpu.parallel.mesh import acc_sharding
+
     return jax.device_put(
-        jnp.zeros((num_metrics, num_buckets), dtype=jnp.int32), sharding
+        jnp.zeros((num_metrics, num_buckets), dtype=jnp.int32),
+        acc_sharding(mesh),
     )
 
 
@@ -329,7 +333,7 @@ def make_interval_distributed_step(
         return folded[None]
 
     ingest = jax.jit(
-        jax.shard_map(
+        shard_map(
             local_ingest,
             mesh=mesh,
             in_specs=(
@@ -354,7 +358,7 @@ def make_interval_distributed_step(
         "percentiles": P(METRIC_AXIS, None),
     }
     collect = jax.jit(
-        jax.shard_map(
+        shard_map(
             local_collect,
             mesh=mesh,
             in_specs=(

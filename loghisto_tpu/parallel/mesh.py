@@ -21,10 +21,49 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 STREAM_AXIS = "stream"
 METRIC_AXIS = "metric"
+
+# jax moved shard_map out of jax.experimental at 0.6; every call site in
+# the package routes through this name so both spellings work.
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax < 0.6
+    from jax.experimental.shard_map import shard_map
+
+
+# -- canonical carry shardings ---------------------------------------------- #
+# Every device carry in the sharded commit pipeline uses one of these
+# four layouts; the committer, the lifecycle/anomaly managers, and the
+# checkpoint restore all build placements through them so the layouts
+# cannot drift apart.
+
+def row_vector_sharding(mesh: Mesh) -> NamedSharding:
+    """int32 [M] carries (the lifecycle activity vector)."""
+    return NamedSharding(mesh, PartitionSpec(METRIC_AXIS))
+
+
+def acc_sharding(mesh: Mesh) -> NamedSharding:
+    """[M, B] carries (accumulator, interval histogram)."""
+    return NamedSharding(mesh, PartitionSpec(METRIC_AXIS, None))
+
+
+def ring_sharding(mesh: Mesh) -> NamedSharding:
+    """[S, M, B] / [K, M, B] carries (tier rings, baseline profiles)."""
+    return NamedSharding(mesh, PartitionSpec(None, METRIC_AXIS, None))
+
+
+def bank_weight_sharding(mesh: Mesh) -> NamedSharding:
+    """f32 [K, M] carries (baseline bank weight mass)."""
+    return NamedSharding(mesh, PartitionSpec(None, METRIC_AXIS))
+
+
+def cell_sharding(mesh: Mesh) -> NamedSharding:
+    """Staged interval cell chunks [N]: split over the stream axis so
+    each device scatters its slice and ONE psum merges the deltas."""
+    return NamedSharding(mesh, PartitionSpec(STREAM_AXIS))
 
 
 def make_mesh(

@@ -66,8 +66,10 @@ class TPUMetricSystem(MetricSystem):
         aggregator fold plus every tier's open-slot scatter behind a
         single subscription (loghisto_tpu.commit.IntervalCommitter);
         "fanout" keeps the per-consumer bridges; "auto" (default)
-        follows the capture-overridable switch in ops/dispatch.py and
-        stays on the fan-out for sharded state.  Without retention the
+        follows the capture-overridable switch in ops/dispatch.py.
+        Sharded state (``mesh=``) runs the fused program under
+        ``shard_map`` — capability-resolved, degrading to the fan-out
+        only when the shape genuinely can't shard.  Without retention the
         aggregator is the only device consumer, so the fan-out IS one
         dispatch already and ``commit`` is moot.
 
@@ -146,7 +148,8 @@ class TPUMetricSystem(MetricSystem):
             else jax.default_backend()
         )
         self.commit_path = resolve_commit_path(
-            commit, platform, mesh=mesh is not None
+            commit, platform, mesh=mesh,
+            num_metrics=self.aggregator.num_metrics,
         )
         self.lifecycle = None
         self.anomaly = None
@@ -209,19 +212,23 @@ class TPUMetricSystem(MetricSystem):
                 # the "fan-out" is already a single dispatch per interval
                 self.commit_path = "fanout"
         if self.committer is None:
+            # mesh-sharded state takes the fused path too (the sharded
+            # shard_map commit); only a genuine fan-out resolution —
+            # explicit commit="fanout", the capture switch, or a shape
+            # that can't shard — lacks the donated carries
             if lifecycle is not None:
                 raise ValueError(
                     "lifecycle rides the fused interval commit; this "
                     f"configuration resolved commit={self.commit_path!r}"
-                    " (mesh-sharded and fan-out pipelines don't carry "
-                    "the activity vector)"
+                    " (the fan-out pipeline doesn't carry the activity "
+                    "vector)"
                 )
             if anomaly is not None:
                 raise ValueError(
                     "the drift engine rides the fused interval commit; "
                     "this configuration resolved "
-                    f"commit={self.commit_path!r} (mesh-sharded and "
-                    "fan-out pipelines don't carry the baseline banks)"
+                    f"commit={self.commit_path!r} (the fan-out pipeline "
+                    "doesn't carry the baseline banks)"
                 )
             self.aggregator.attach(self)
             if self.retention is not None:

@@ -286,9 +286,22 @@ def restore(
                         )
                         padded[:, :dense.shape[1]] = dense
                         dense = padded
-                    aggregator._acc = (
-                        aggregator._acc + jnp.asarray(dense)
+                    # re-shard the host rows onto the live accumulator's
+                    # layout first: checkpoints save gathered host
+                    # arrays, so a snapshot taken on one mesh shape
+                    # restores onto any other (or none at all)
+                    delta = jnp.asarray(dense)
+                    live_sharding = getattr(
+                        aggregator._acc, "sharding", None
                     )
+                    if (
+                        getattr(aggregator, "mesh", None) is not None
+                        and live_sharding is not None
+                    ):
+                        import jax
+
+                        delta = jax.device_put(delta, live_sharding)
+                    aggregator._acc = aggregator._acc + delta
             id_remap = dict(row_map)
             with aggregator._agg_lock:
                 agg_compat = aggregator.config.go_compat

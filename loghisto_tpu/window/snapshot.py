@@ -26,6 +26,14 @@ sliced off after readback) so repeated query shapes with drifting match
 counts reuse one jitted executable per (tier, n_ids-bucket, P) — jax's
 shape-keyed executable cache is the backing store, this class just
 stabilizes the shapes and counts hits/misses for the self-metrics.
+
+Mesh-sharded state (PR 8): snapshot payloads come out of the sharded
+fused commit still metric-row-sharded — the handle is published without
+gathering them (full replication of a 10k-row CDF per interval would
+swamp the interconnect).  The query fn (ops/stats.py) then gathers ONLY
+the requested rows from their owning shard and lands the tiny [n, P]
+result replicated for local host readback; warm result-cache hits stay
+zero-dispatch exactly as on one device.
 """
 
 from __future__ import annotations

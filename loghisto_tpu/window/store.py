@@ -257,8 +257,12 @@ class TimeWheel:
         self._snapshot_fn = make_window_snapshot_fn(
             config.bucket_limit, config.precision, self.merge_path
         )
+        # under a mesh the snapshot views stay metric-row-sharded; the
+        # query fn's gather then ships ONLY the requested rows from
+        # their owning shard (replicated [n, P] results for local
+        # host readback) — warm result-cache hits stay zero-dispatch
         self._query_fn = make_snapshot_query_fn(
-            config.bucket_limit, config.precision
+            config.bucket_limit, config.precision, mesh
         )
         self._snapshot: Optional[Snapshot] = None
         self._pinned: List[float] = []      # pinned window seconds

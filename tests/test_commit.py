@@ -286,7 +286,9 @@ def test_commit_incompatibility_detects_split_registries():
 
 def test_resolve_commit_path_policy(monkeypatch):
     assert resolve_commit_path("auto", "cpu") == "fused"
-    assert resolve_commit_path("auto", "tpu", mesh=True) == "fanout"
+    # a capable sharded configuration resolves to the sharded fused path
+    # (legacy bool callers mean "sharded and capable")
+    assert resolve_commit_path("auto", "tpu", mesh=True) == "fused"
     assert resolve_commit_path("fanout", "tpu") == "fanout"
     assert resolve_commit_path("fused", "tpu", mesh=True) == "fused"
     with pytest.raises(ValueError):

@@ -210,3 +210,65 @@ def test_derive_thresholds_non_tpu_refused():
     assert derive_thresholds(
         "d", {"platform": "cpu"}, {16: "scatter"}
     ) is None
+
+
+# ---------------------------------------------------------------------- #
+# capability-based mesh commit resolution: a sharded configuration only
+# degrades off the fused path for a reason it can articulate
+# ---------------------------------------------------------------------- #
+
+class _MeshStub:
+    """Just the surface mesh_commit_incapability inspects."""
+
+    def __init__(self, axis_names, shape):
+        self.axis_names = axis_names
+        self.shape = shape
+
+
+def test_mesh_commit_incapability_accepts_commit_layout():
+    mesh = _MeshStub(("stream", "metric"), {"stream": 2, "metric": 4})
+    assert dispatch.mesh_commit_incapability(None) is None
+    assert dispatch.mesh_commit_incapability(mesh) is None
+    assert dispatch.mesh_commit_incapability(mesh, num_metrics=16) is None
+
+
+def test_mesh_commit_incapability_names_wrong_axis_layout():
+    mesh = _MeshStub(("x", "y"), {"x": 4, "y": 2})
+    reason = dispatch.mesh_commit_incapability(mesh)
+    assert reason is not None
+    assert "('x', 'y')" in reason and "'stream'" in reason
+    assert "'metric'" in reason
+
+
+def test_mesh_commit_incapability_names_indivisible_rows():
+    mesh = _MeshStub(("stream", "metric"), {"stream": 2, "metric": 3})
+    reason = dispatch.mesh_commit_incapability(mesh, num_metrics=16)
+    assert reason is not None
+    assert "num_metrics=16" in reason and "3-way" in reason
+
+
+def test_resolve_commit_path_capable_mesh_stays_fused():
+    mesh = _MeshStub(("stream", "metric"), {"stream": 2, "metric": 4})
+    assert dispatch.resolve_commit_path(
+        "auto", "cpu", mesh=mesh, num_metrics=16) == "fused"
+    assert dispatch.resolve_commit_path(
+        "fused", "cpu", mesh=mesh, num_metrics=16) == "fused"
+    # fanout remains an explicit opt-out, never second-guessed
+    assert dispatch.resolve_commit_path(
+        "fanout", "cpu", mesh=mesh, num_metrics=16) == "fanout"
+
+
+def test_resolve_commit_path_auto_degrades_with_reason():
+    mesh = _MeshStub(("stream", "metric"), {"stream": 2, "metric": 3})
+    assert dispatch.resolve_commit_path(
+        "auto", "cpu", mesh=mesh, num_metrics=16) == "fanout"
+
+
+def test_resolve_commit_path_explicit_fused_raises_the_reason():
+    mesh = _MeshStub(("x", "y"), {"x": 4, "y": 2})
+    with pytest.raises(ValueError, match=r"\('x', 'y'\)"):
+        dispatch.resolve_commit_path("fused", "cpu", mesh=mesh)
+    bad_rows = _MeshStub(("stream", "metric"), {"stream": 2, "metric": 3})
+    with pytest.raises(ValueError, match="num_metrics=16"):
+        dispatch.resolve_commit_path(
+            "fused", "cpu", mesh=bad_rows, num_metrics=16)

@@ -77,6 +77,37 @@ def test_drift_alerts_example_runs():
     assert "anomaly.api.latency.jsd" in out
 
 
+def test_multichip_metrics_example_runs():
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "multichip_metrics.py")],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    # the 2x4 mesh came up on 8 virtual devices and auto resolved fused
+    assert "mesh: 2 stream x 4 metric over 8 devices" in out
+    assert "commit path: fused" in out
+    assert "backfilled 120 intervals through the sharded fused commit" in out
+    # every interval took the sharded single-dispatch path (ISSUE 8
+    # acceptance: the dispatch budget holds under the mesh)
+    assert "fused intervals: 120 of 120" in out
+    assert "1 dispatches, 1 upload" in out
+    # lifecycle bounded the churn on sharded carries...
+    assert "-> 20 live rows" in out
+    assert "342 evicted" in out
+    # ...and the drift rule paged during the cache bug off shard-local
+    # maintained baselines
+    timeline = [ln for ln in out.splitlines() if "FIRING" in ln
+                or "RESOLVED" in ln]
+    assert any("cache bug" in ln and "FIRING   api_latency_shape" in ln
+               for ln in timeline)
+    assert "active alerts: none" in out
+    # queries served from the still-sharded snapshots
+    assert "served from metric-row-sharded snapshots" in out
+    assert "api.latency p50=50ms" in out
+
+
 def test_migrate_from_go_example_runs():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", "migrate_from_go.py")],

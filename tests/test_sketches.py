@@ -434,7 +434,7 @@ def test_hll_merges_over_mesh_with_pmax():
     from jax.sharding import PartitionSpec as P
 
     from loghisto_tpu.models import hll
-    from loghisto_tpu.parallel.mesh import STREAM_AXIS, make_mesh
+    from loghisto_tpu.parallel.mesh import STREAM_AXIS, make_mesh, shard_map
 
     mesh = make_mesh(stream=8, metric=1)
     rng = np.random.default_rng(6)
@@ -445,7 +445,7 @@ def test_hll_merges_over_mesh_with_pmax():
         regs = hll.insert(hll.empty(), vals)
         return jax.lax.pmax(regs, STREAM_AXIS)
 
-    merged = jax.jit(jax.shard_map(
+    merged = jax.jit(shard_map(
         local, mesh=mesh, in_specs=P(STREAM_AXIS),
         out_specs=P(),  # pmax replicates the union
     ))(values)
