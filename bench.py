@@ -536,6 +536,24 @@ def main() -> None:
         print(f"bench: mesh-commit stage failed: {e}", file=sys.stderr)
     ready6.set()
 
+    # self-observability headline (benchmarks/obs_overhead.py has the
+    # full stage table): span-recorder throughput cost on the firehose
+    # (< 2% budget) and the pipeline's own end-to-end commit p99 as
+    # read from its span ring.
+    ready7 = _start_watchdog(300.0, on_timeout=lambda: print(
+        json.dumps(result), flush=True
+    ))
+    try:
+        from benchmarks.obs_overhead import run as obs_run
+
+        obs = obs_run(reps=3, seconds=1.0)
+        result["obs_overhead_pct"] = obs["obs_overhead_pct"]
+        result["obs_overhead_suspect"] = obs["suspect"]
+        result["pipeline_stage_p99_us"] = obs["pipeline_stage_p99_us"]
+    except Exception as e:  # never let the extra metric kill the bench
+        print(f"bench: obs-overhead stage failed: {e}", file=sys.stderr)
+    ready7.set()
+
     print(json.dumps(result))
 
 

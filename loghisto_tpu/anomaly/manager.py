@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from loghisto_tpu.anomaly.config import AnomalyConfig
+from loghisto_tpu.obs.spans import NULL_RECORDER
 from loghisto_tpu.ops.anomaly import (
     make_bank_compact_fn,
     make_bank_evict_fn,
@@ -132,6 +133,10 @@ class AnomalyManager:
         # lazy per-metric gauge export (anomaly.<name>.{ks,jsd,emd})
         self._export_key = None  # (generation, registry high-water)
         self._exported: set = set()
+
+        # observability (ISSUE 9): scoring-cadence spans; swapped for a
+        # real ring by TPUMetricSystem(observability=...)
+        self.obs_recorder = NULL_RECORDER
 
     # -- traced scalar operands for the fused programs ------------------- #
 
@@ -253,7 +258,8 @@ class AnomalyManager:
         if self._intervals_seen % self.config.check_every:
             return
         try:
-            self.score_now(raw.time)
+            with self.obs_recorder.span("anomaly.score", raw.seq):
+                self.score_now(raw.time)
         except Exception:  # pragma: no cover - defensive
             logger.exception("anomaly scoring failed")
 

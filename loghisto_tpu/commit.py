@@ -50,7 +50,6 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from collections import deque
 from typing import Optional
 
 import jax
@@ -59,6 +58,7 @@ import numpy as np
 
 from loghisto_tpu.channel import ChannelClosed, ResilientSubscription
 from loghisto_tpu.metrics import MetricSystem, RawMetricSet
+from loghisto_tpu.obs.spans import NULL_RECORDER, LatencyHistogram
 from loghisto_tpu.ops.commit import (
     COMMIT_CHUNK,
     CellStagingRing,
@@ -175,8 +175,11 @@ class IntervalCommitter:
                                         width=self.chunk,
                                         sharding=staging_sharding)
 
-        # self-metrics (ISSUE 2): per-interval dispatch/H2D accounting
-        # plus a bounded latency reservoir for the percentile gauges
+        # self-metrics (ISSUE 2): per-interval dispatch/H2D accounting.
+        # The latency store IS one of the system's own log-bucketed
+        # histograms (ISSUE 9 dogfooding): the LatencyP50Us/P99Us gauges
+        # are served by the same codec + CDF walk as every user metric,
+        # not an ad-hoc bounded host reservoir.
         self._metrics_lock = threading.Lock()
         self.intervals_committed = 0
         self.fused_intervals = 0
@@ -184,7 +187,14 @@ class IntervalCommitter:
         self.last_dispatches = 0
         self.last_h2d_bytes = 0
         self.last_uploads = 0
-        self._latencies_us: deque = deque(maxlen=1024)
+        self._latency_hist = LatencyHistogram(wheel.config.precision)
+
+        # observability (ISSUE 9): span ring + dogfooding + watchdog,
+        # all installed by TPUMetricSystem(observability=...); the
+        # defaults cost two no-op calls per site
+        self.obs_recorder = NULL_RECORDER
+        self.self_observer = None
+        self.watchdog = None
 
         self._ms: Optional[MetricSystem] = None
         self._sub: Optional[ResilientSubscription] = None
@@ -237,6 +247,11 @@ class IntervalCommitter:
     def commit(self, raw: RawMetricSet, duration: Optional[float] = None):
         """Land one interval on the aggregator AND every retention tier.
         Returns the path taken ("fused", "fanout", or "empty")."""
+        rec = self.obs_recorder
+        # adopt the reaper-minted interval sequence number: every span
+        # recorded until the next commit attributes to this interval
+        seq = rec.begin_interval(raw.seq)
+        t0_ns = time.perf_counter_ns()
         t0 = time.perf_counter()
         wheel = self.wheel
         dur = (
@@ -246,7 +261,8 @@ class IntervalCommitter:
         )
         up0 = self._staging.uploads
         b0 = self._staging.bytes_uploaded
-        cells = self._cells_from_raw(raw)
+        with rec.span("commit.cells", seq):
+            cells = self._cells_from_raw(raw)
         if cells is None:
             # cell-less interval: slot rotation/durations still advance
             # (a reopened slot's clear is the only possible dispatch)
@@ -268,6 +284,8 @@ class IntervalCommitter:
             # while rows are folded or repacked
             self.lifecycle.on_interval()
         us = (time.perf_counter() - t0) * 1e6
+        # the end-to-end span every stage span above nests inside
+        rec.record("commit.e2e", t0_ns, time.perf_counter_ns(), seq)
         with self._metrics_lock:
             self.intervals_committed += 1
             if mode == "fused":
@@ -277,7 +295,7 @@ class IntervalCommitter:
             self.last_dispatches = dispatches
             self.last_uploads = self._staging.uploads - up0
             self.last_h2d_bytes = self._staging.bytes_uploaded - b0
-            self._latencies_us.append(us)
+        self._latency_hist.add(us)
         if self._ms is not None:
             # the commit latency histogram rides the normal pipeline,
             # so exporters/retention see it like any other metric
@@ -285,6 +303,12 @@ class IntervalCommitter:
                 self._ms.histogram("commit.LatencyUs", us)
             except Exception:  # pragma: no cover - defensive
                 pass
+        if self.watchdog is not None:
+            self.watchdog.note_commit(seq)
+        if self.self_observer is not None:
+            # dogfooding: this interval's closed spans re-enter through
+            # the normal histogram() path as obs.<stage>.LatencyUs
+            self.self_observer.on_interval(seq)
         return mode
 
     def _commit_cells(self, cells, raw: RawMetricSet, dur: float):
@@ -389,13 +413,15 @@ class IntervalCommitter:
         reset_tiers = ()
         payloads = acc_payload = None
         try:
+            rec = self.obs_recorder
             for off in range(0, n, self.chunk):
                 take = min(self.chunk, n - off)
-                dev_ids, dev_idx, dev_w = self._staging.stage(
-                    ids[off:off + take],
-                    idx[off:off + take],
-                    w32[off:off + take],
-                )
+                with rec.span("commit.upload"):
+                    dev_ids, dev_idx, dev_w = self._staging.stage(
+                        ids[off:off + take],
+                        idx[off:off + take],
+                        w32[off:off + take],
+                    )
                 chunk_keeps = keeps if dispatches == 0 else ones
                 final = emit and off + take >= n
                 # operand ordering per make_fused_commit_fn /
@@ -422,9 +448,10 @@ class IntervalCommitter:
                     args.append(np.int32(0 if dispatches == 0 else 1))
                     if final:
                         args += [bank, an.decay32, an.min_count32]
-                out = iter(
-                    (self._fused_snap if final else self._fused)(*args)
-                )
+                with rec.span("commit.dispatch"):
+                    out = iter(
+                        (self._fused_snap if final else self._fused)(*args)
+                    )
                 agg._acc = next(out)
                 for t, r in zip(tiers, next(out)):
                     t.ring = r
@@ -445,6 +472,13 @@ class IntervalCommitter:
                 agg._interval_ingested += int(
                     w64[off:off + take].sum(dtype=np.int64)
                 )
+            if rec.enabled and dispatches:
+                # only when observing: wait out the async dispatches so
+                # the device-sync span carries the real device time
+                # instead of it leaking into whoever touches the carries
+                # next (a device failure here takes the normal recovery)
+                with rec.span("commit.device_sync"):
+                    jax.block_until_ready(agg._acc)
         except Exception:
             payloads = acc_payload = None
             reset_tiers = self._on_fused_failure_locked(
@@ -457,17 +491,18 @@ class IntervalCommitter:
         if payloads is not None and not reset_tiers:
             # the tier metadata now matches the simulated post-close
             # state the masks encoded; publish the lock-free handles
-            wheel.publish_snapshot_locked(tuple(
-                wheel._tier_snapshot_locked(ti, windows, masks[ti],
-                                            payloads[ti])
-                for ti in range(len(tiers))
-            ))
-            agg.stats_snapshot = AccSnapshot(
-                epoch=wheel.intervals_pushed,
-                cdf=acc_payload["cdf"],
-                counts=acc_payload["counts"],
-                sums=acc_payload["sums"],
-            )
+            with self.obs_recorder.span("commit.snapshot_publish"):
+                wheel.publish_snapshot_locked(tuple(
+                    wheel._tier_snapshot_locked(ti, windows, masks[ti],
+                                                payloads[ti])
+                    for ti in range(len(tiers))
+                ))
+                agg.stats_snapshot = AccSnapshot(
+                    epoch=wheel.intervals_pushed,
+                    cdf=acc_payload["cdf"],
+                    counts=acc_payload["counts"],
+                    sums=acc_payload["sums"],
+                )
         return dispatches
 
     def _on_fused_failure_locked(self, cells, applied: int):
@@ -648,10 +683,10 @@ class IntervalCommitter:
         return self._sub.evictions if self._sub is not None else 0
 
     def _latency_pct(self, q: float) -> float:
-        with self._metrics_lock:
-            if not self._latencies_us:
-                return 0.0
-            return float(np.percentile(np.asarray(self._latencies_us), q))
+        # served from the system's own log-bucketed state (ISSUE 9):
+        # same codec + CDF walk as any user histogram, full lifetime
+        # history instead of a bounded reservoir
+        return self._latency_hist.percentile(q)
 
     def register_gauges(self, ms: MetricSystem) -> None:
         """Export the commit-path self-metrics through the normal gauge

@@ -202,16 +202,22 @@ def run_firehose(
     max_inflight: int = 8,
     ingest_path: str = "auto",
     max_interval_samples: Optional[int] = None,
+    recorder=None,
 ) -> dict:
     """Run the firehose; returns a summary dict (samples/s, intervals).
     With `mesh`, generation+aggregation run SPMD with psum merges.
     `max_interval_samples` overrides the int32-exactness early-close
-    budget (default 2^31 - batch; see the guard below)."""
+    budget (default 2^31 - batch; see the guard below).  ``recorder``
+    (an obs.SpanRecorder) records a span per dispatch step, per
+    interval, and per export — the contender knob behind
+    benchmarks/obs_overhead.py's < 2%% recorder-cost criterion."""
     import jax
     import jax.numpy as jnp
 
+    from loghisto_tpu.obs.spans import NULL_RECORDER
     from loghisto_tpu.ops.stats import dense_stats
 
+    rec = recorder if recorder is not None else NULL_RECORDER
     config = config or MetricConfig()
     ingest = collect = partial = None
     if mesh is not None:
@@ -268,6 +274,8 @@ def run_firehose(
     intervals = 0
     t_start = time.perf_counter()
     while time.perf_counter() - t_start < seconds:
+        rec.begin_interval()
+        t_int_ns = time.perf_counter_ns()
         t_int = time.perf_counter()
         interval_samples = 0
         inflight = 0
@@ -278,10 +286,12 @@ def run_firehose(
                     f"({interval_samples:,} samples)\n"
                 )
                 break
+            step_ns = time.perf_counter_ns()
             if mesh is not None:
                 partial, key = ingest(partial, key)
             else:
                 acc, key = step(acc, key)
+            rec.record("firehose.step", step_ns, time.perf_counter_ns())
             interval_samples += batch
             # bound the async dispatch queue: without this, a dispatcher
             # that runs ahead of the device (or of a slow link) enqueues
@@ -304,27 +314,29 @@ def run_firehose(
         total_samples += interval_samples
 
         # serialize the hottest metrics for the export replay
-        metrics = {}
-        hot = np.argsort(counts)[::-1][:16]
-        for mid in hot:
-            if counts[mid] == 0:
-                continue
-            name = f"firehose_{mid}"
-            metrics[f"{name}_count"] = float(counts[mid])
-            metrics[f"{name}_sum"] = float(sums[mid])
-            for label, value in zip(labels, pcts[mid]):
-                metrics[label % name] = float(value)
-        pms = ProcessedMetricSet(
-            time=_dt.datetime.now(tz=_dt.timezone.utc), metrics=metrics
-        )
-        payload = opentsdb_protocol(pms)
-        if sink is not None:
-            from loghisto_tpu.submitter import send_once
+        with rec.span("firehose.export"):
+            metrics = {}
+            hot = np.argsort(counts)[::-1][:16]
+            for mid in hot:
+                if counts[mid] == 0:
+                    continue
+                name = f"firehose_{mid}"
+                metrics[f"{name}_count"] = float(counts[mid])
+                metrics[f"{name}_sum"] = float(sums[mid])
+                for label, value in zip(labels, pcts[mid]):
+                    metrics[label % name] = float(value)
+            pms = ProcessedMetricSet(
+                time=_dt.datetime.now(tz=_dt.timezone.utc), metrics=metrics
+            )
+            payload = opentsdb_protocol(pms)
+            if sink is not None:
+                from loghisto_tpu.submitter import send_once
 
-            err = send_once("tcp", sink, payload)
-            status = "sent" if err is None else f"error: {err}"
-        else:
-            status = f"{len(payload)} bytes serialized"
+                err = send_once("tcp", sink, payload)
+                status = "sent" if err is None else f"error: {err}"
+            else:
+                status = f"{len(payload)} bytes serialized"
+        rec.record("firehose.interval", t_int_ns, time.perf_counter_ns())
         rate = interval_samples / (time.perf_counter() - t_int)
         out.write(
             f"interval {intervals}: {interval_samples:,} samples "

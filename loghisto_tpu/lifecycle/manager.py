@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from loghisto_tpu.lifecycle.policy import LifecycleConfig, decide_victims
+from loghisto_tpu.obs.spans import NULL_RECORDER
 from loghisto_tpu.ops.commit import DROP_ID
 from loghisto_tpu.ops.lifecycle import (
     make_compact_fn,
@@ -109,6 +110,10 @@ class LifecycleManager:
         self.last_compaction_us = 0.0
         self._compaction_us: deque = deque(maxlen=256)
         self._metrics_lock = threading.Lock()
+
+        # observability (ISSUE 9): policy-tick spans; swapped for a real
+        # ring by TPUMetricSystem(observability=...)
+        self.obs_recorder = NULL_RECORDER
 
     # -- epoch / activity carry (callers hold agg._dev_lock) ------------- #
 
@@ -182,7 +187,8 @@ class LifecycleManager:
         if self._intervals_seen % self.config.check_every:
             return
         try:
-            self.check()
+            with self.obs_recorder.span("lifecycle.tick"):
+                self.check()
         except Exception:  # pragma: no cover - defensive
             logger.exception("lifecycle policy check failed")
 

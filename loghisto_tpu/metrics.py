@@ -58,6 +58,7 @@ import numpy as np
 
 from loghisto_tpu.channel import Channel
 from loghisto_tpu.config import DEFAULT_PERCENTILES, MetricConfig
+from loghisto_tpu.obs.spans import NULL_RECORDER
 from loghisto_tpu.ops.codec import compress_np
 from loghisto_tpu.ops.stats import percentiles_sparse, summarize_sparse
 from loghisto_tpu.utils.sysstats import default_gauges
@@ -79,6 +80,12 @@ class RawMetricSet:
     per-interval deltas, so any consumer doing per-second math (burn
     rates, replayed-history rates in the timewheel) needs the real
     duration, not an assumed live interval.
+
+    ``seq`` is the interval sequence number minted by the reaper at
+    collection (ISSUE 9): every observability span recorded while this
+    set moves through the pipeline attributes to it, and journal lines
+    carry it so replayed intervals correlate with archived traces.
+    None for pre-obs sets (old journal lines, hand-built sets).
     """
 
     time: _dt.datetime
@@ -87,6 +94,7 @@ class RawMetricSet:
     histograms: Dict[str, Dict[int, int]]
     gauges: Dict[str, float]
     duration: Optional[float] = None
+    seq: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -129,6 +137,9 @@ def merge_raw_metric_sets(a: RawMetricSet, b: RawMetricSet) -> RawMetricSet:
         histograms=histograms,
         gauges=gauges,
         duration=duration,
+        # two different intervals merged: neither seq attributes the
+        # result, so trace correlation honestly says "unknown"
+        seq=a.seq if a.seq == b.seq else None,
     )
 
 
@@ -455,6 +466,14 @@ class MetricSystem:
         self._lifecycle_lock = threading.Lock()
         self._shutdown = threading.Event()
         self._reaper_thread: Optional[threading.Thread] = None
+
+        # observability (ISSUE 9): the reaper mints one sequence number
+        # per collected interval; every pipeline span downstream of this
+        # RawMetricSet attributes to it.  The recorder defaults to the
+        # no-op twin; TPUMetricSystem(observability=...) swaps in a real
+        # ring.
+        self._interval_seq = itertools.count(1)
+        self.obs_recorder = NULL_RECORDER
 
     # ------------------------------------------------------------------ #
     # ingest hot path (reference layer L2)
@@ -924,6 +943,7 @@ class MetricSystem:
             histograms=histograms,
             gauges=gauges,
             duration=self.interval,
+            seq=next(self._interval_seq),
         )
 
     # ------------------------------------------------------------------ #
@@ -1040,8 +1060,9 @@ class MetricSystem:
         raw = self.collect_raw_metrics()
         self._update_subscribers()
 
-        with self._subscribers_lock:
-            self._broadcast(self._raw_subscribers, raw)
+        with self.obs_recorder.span("obs.broadcast", raw.seq):
+            with self._subscribers_lock:
+                self._broadcast(self._raw_subscribers, raw)
 
         def send_processed(raw=raw):
             processed = self.process_metrics(raw)

@@ -1,0 +1,97 @@
+"""Span-ring export as Chrome ``trace_events`` JSON (Perfetto-openable).
+
+``trace_events()`` turns the recorder's closed spans into the legacy
+Chrome JSON trace format (the ``traceEvents`` array form), which
+https://ui.perfetto.dev opens directly:
+
+  * every recording thread becomes one track (``tid`` minted per thread
+    name, named via ``"M"`` thread_name metadata events);
+  * every span becomes one ``"X"`` complete event — ``ts``/``dur`` in
+    microseconds on the ``perf_counter_ns`` timebase, the stage as the
+    event name, and the interval sequence number in ``args.seq``;
+  * each interval's spans are chained with flow events (``"s"``
+    start on the interval's first span, ``"t"`` steps on the rest,
+    ``id`` = the interval seq), so selecting one commit in Perfetto
+    draws arrows through every stage that interval touched, across
+    threads.
+
+The µs timestamps share the clock used by ``utils/trace.py``'s
+jax.profiler regions, so a ``LOGHISTO_TRACE_DIR`` capture of the same
+run lines up with this dump: the ``commit.e2e`` span here brackets the
+``fused_commit`` TraceAnnotation there.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Optional
+
+from loghisto_tpu.obs.spans import Span
+
+_PID = 1  # single-process trace: one process group in the UI
+
+
+def trace_events(
+    recorder,
+    process_name: str = "loghisto_tpu",
+    seqs: Optional[Iterable[int]] = None,
+) -> List[dict]:
+    """The ``traceEvents`` list for the recorder's current ring
+    contents (optionally restricted to the given interval seqs)."""
+    spans: List[Span] = sorted(recorder.spans(), key=lambda s: s.start_ns)
+    if seqs is not None:
+        wanted = set(seqs)
+        spans = [s for s in spans if s.seq in wanted]
+
+    events: List[dict] = [{
+        "ph": "M", "pid": _PID, "tid": 0, "name": "process_name",
+        "args": {"name": process_name},
+    }]
+    tids: Dict[str, int] = {}
+    for s in spans:
+        if s.thread not in tids:
+            tid = tids[s.thread] = len(tids) + 1
+            events.append({
+                "ph": "M", "pid": _PID, "tid": tid,
+                "name": "thread_name", "args": {"name": s.thread},
+            })
+
+    flow_started: Dict[int, bool] = {}
+    for s in spans:
+        tid = tids[s.thread]
+        ts = s.start_ns / 1e3  # µs, perf_counter timebase
+        events.append({
+            "ph": "X", "pid": _PID, "tid": tid, "name": s.stage,
+            "cat": "pipeline", "ts": ts, "dur": s.duration_us,
+            "args": {"seq": s.seq},
+        })
+        if s.seq:  # chain this interval's spans with flow arrows
+            ph = "t" if flow_started.get(s.seq) else "s"
+            flow_started[s.seq] = True
+            events.append({
+                "ph": ph, "pid": _PID, "tid": tid, "name": "interval",
+                "cat": "interval", "id": s.seq, "ts": ts,
+            })
+    return events
+
+
+def dump_perfetto(
+    recorder,
+    path: str,
+    process_name: str = "loghisto_tpu",
+    seqs: Optional[Iterable[int]] = None,
+) -> int:
+    """Write the trace as ``{"traceEvents": [...], ...}`` JSON to
+    ``path``; returns the number of events written."""
+    events = trace_events(recorder, process_name=process_name, seqs=seqs)
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "loghisto_tpu.obs",
+            "clock": "perf_counter_ns",
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(events)

@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from loghisto_tpu.config import DEFAULT_PERCENTILES, PRECISION, MetricConfig
 from loghisto_tpu.metrics import MetricSystem, ProcessedMetricSet, RawMetricSet
 from loghisto_tpu.channel import Channel, ChannelClosed
+from loghisto_tpu.obs.spans import NULL_RECORDER
 from loghisto_tpu.ops.ingest import (
     make_ingest_fn,
     make_weighted_ingest_fn,
@@ -575,6 +576,9 @@ class TPUAggregator:
         # dispatch; None whenever the accumulator was reset, grown,
         # spilled, or rebuilt — readers must treat None as "recompute"
         self.stats_snapshot = None
+        # observability (ISSUE 9): flush/drain spans; swapped for a real
+        # ring by TPUMetricSystem(observability=...)
+        self.obs_recorder = NULL_RECORDER
 
         if on_registry_full not in ("grow", "error"):
             raise ValueError(
@@ -1002,6 +1006,13 @@ class TPUAggregator:
         is idle."""
         return self._requeue_count + self._pending_count
 
+    @property
+    def pending_samples(self) -> int:
+        """Public monitoring alias for the host-buffered sample count —
+        the health watchdog's ingest-backpressure signal (compared
+        against ``max_pending_samples``)."""
+        return self._buffered_samples()
+
     def _bound_pending_locked(self) -> None:
         """Enforce max_pending_samples over the WHOLE host buffer
         (requeue + pending) by shedding the OLDEST samples — the requeue
@@ -1056,6 +1067,10 @@ class TPUAggregator:
         oldest shed first) and retries are cooldown-gated so a down
         device costs one attempt per retry_cooldown, not one per
         record."""
+        with self.obs_recorder.span("ingest.flush"):
+            self._flush_impl(force)
+
+    def _flush_impl(self, force: bool) -> None:
         if self._cell_store is not None:
             # preagg: samples were folded at record time; flushing means
             # shipping the deduped cells.  Mid-interval ships happen only
@@ -1202,7 +1217,8 @@ class TPUAggregator:
                 item = self._xfer_queue.popleft()
                 self._xfer_active = True
             try:
-                self._process_xfer_item(item)
+                with self.obs_recorder.span("ingest.drain"):
+                    self._process_xfer_item(item)
             except Exception:  # pragma: no cover - defensive
                 import logging
 

@@ -206,6 +206,9 @@ class PrometheusEndpoint:
                 import urllib.parse
 
                 path = urllib.parse.urlsplit(self.path).path.rstrip("/")
+                if path == "/healthz":
+                    self._serve_healthz()
+                    return
                 if path not in ("", "/metrics"):
                     self.send_error(404)
                     return
@@ -216,6 +219,40 @@ class PrometheusEndpoint:
                 self.send_header(
                     "Content-Type", "text/plain; version=0.0.4"
                 )
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _serve_healthz(self):
+                """Machine-readable pipeline health (ISSUE 9): the
+                watchdog's HealthReport as JSON.  503 when stalled so
+                orchestrator liveness probes fail without parsing;
+                degraded stays 200 (serving, with reasons attached)."""
+                import json
+
+                watchdog = getattr(endpoint._ms, "health", None)
+                if watchdog is None:
+                    doc = {
+                        "status": "unknown",
+                        "ok": True,
+                        "reasons": [{
+                            "code": "no_watchdog",
+                            "detail": (
+                                "observability is not enabled on this "
+                                "system (TPUMetricSystem(observability"
+                                "=ObsConfig(...)))"
+                            ),
+                            "value": 0.0,
+                        }],
+                    }
+                    status = 200
+                else:
+                    report = watchdog.report()
+                    doc = report.as_dict()
+                    status = 503 if report.status == "stalled" else 200
+                payload = json.dumps(doc).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)

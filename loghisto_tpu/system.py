@@ -52,6 +52,7 @@ class TPUMetricSystem(MetricSystem):
         lifecycle=None,
         anomaly=None,
         transport: str = "auto",
+        observability=None,
     ):
         """``retention`` turns on the windowed retention tier:
         ``True`` builds a TimeWheel with the default 60x1 / 60x60 /
@@ -95,7 +96,18 @@ class TPUMetricSystem(MetricSystem):
 
         ``transport`` passes through to the TPUAggregator's host->device
         transport selection ("auto" / "raw" / "preagg" / "sparse"; see
-        TPUAggregator.__init__)."""
+        TPUAggregator.__init__).
+
+        ``observability`` takes an ``obs.ObsConfig`` (or ``True`` for
+        the defaults) and turns on the self-observability subsystem
+        (ISSUE 9): a lock-free span ring records interval-scoped stage
+        timings across the whole pipeline, closed spans are re-ingested
+        as ``obs.<stage>.LatencyUs`` histograms through the normal
+        ingest path, a health watchdog exports ``health.*`` gauges and
+        the Prometheus endpoint's ``/healthz`` JSON, and the span ring
+        dumps as Perfetto-compatible Chrome trace JSON
+        (``obs.dump_perfetto(ms.obs, path)``).  ``debug_dump()`` works
+        with or without it."""
         super().__init__(
             interval=interval, sys_stats=sys_stats, config=config,
             fast_ingest=fast_ingest,
@@ -140,7 +152,9 @@ class TPUMetricSystem(MetricSystem):
 
         import jax
 
-        from loghisto_tpu.ops.dispatch import resolve_commit_path
+        from loghisto_tpu.ops.dispatch import (
+            mesh_commit_incapability, resolve_commit_path,
+        )
 
         platform = (
             mesh.devices.flat[0].platform
@@ -233,6 +247,114 @@ class TPUMetricSystem(MetricSystem):
             self.aggregator.attach(self)
             if self.retention is not None:
                 self.retention.attach(self)
+
+        # -- self-observability (ISSUE 9) ------------------------------- #
+        self.obs = None            # the SpanRecorder (None when off)
+        self.obs_config = None
+        self.health = None         # the HealthWatchdog (None when off)
+        self.self_observer = None
+        self.commit_path_reason = (
+            mesh_commit_incapability(
+                mesh, num_metrics=self.aggregator.num_metrics
+            )
+            if mesh is not None and self.commit_path != "fused" else None
+        )
+        if observability is not None and observability is not False:
+            from loghisto_tpu.obs import (
+                HealthWatchdog, ObsConfig, SelfObserver, SpanRecorder,
+            )
+
+            cfg = ObsConfig() if observability is True else observability
+            self.obs_config = cfg
+            rec = SpanRecorder(cfg.capacity)
+            self.obs = rec
+            # hand the ring to every instrumentation site
+            self.obs_recorder = rec          # reaper broadcast span
+            self.aggregator.obs_recorder = rec
+            if self.retention is not None:
+                self.retention.obs_recorder = rec
+            if self.lifecycle is not None:
+                self.lifecycle.obs_recorder = rec
+            if self.anomaly is not None:
+                self.anomaly.obs_recorder = rec
+            if self.committer is not None:
+                self.committer.obs_recorder = rec
+                if cfg.dogfood:
+                    self.self_observer = SelfObserver(self, rec)
+                    self.committer.self_observer = self.self_observer
+            if cfg.health:
+                self.health = HealthWatchdog(
+                    self.committer, self.aggregator,
+                    interval=self.interval,
+                    stall_intervals=cfg.stall_intervals,
+                    backpressure_fraction=cfg.backpressure_fraction,
+                    commit_path=self.commit_path,
+                    commit_path_reason=self.commit_path_reason,
+                    wheel=self.retention,
+                )
+                if self.committer is not None:
+                    self.committer.watchdog = self.health
+                self.health.register_gauges(self)
+
+    def debug_dump(self) -> dict:
+        """One introspection snapshot of the whole pipeline: registry
+        occupancy and free-list depth, the resolved commit path (with
+        the mesh-incapability reason when it degraded), query/result
+        cache hit counters, mesh layout, transfer/staging ring depths,
+        span-ring state, and the current health report.  Pure reads —
+        safe to call from any thread, any time."""
+        agg = self.aggregator
+        reg = agg.registry
+        dump: dict = {
+            "commit_path": self.commit_path,
+            "commit_path_reason": self.commit_path_reason,
+            "mesh": (
+                {str(k): int(v) for k, v in agg.mesh.shape.items()}
+                if agg.mesh is not None else None
+            ),
+            "registry": {
+                "capacity": reg.capacity,
+                "occupancy": len(reg),
+                "free_count": reg.free_count(),
+                "generation": reg.generation,
+            },
+            "rings": {
+                "xfer_queued_samples": agg._xfer_queued_samples,
+                "pending_samples": agg.pending_samples,
+                "max_pending_samples": agg.max_pending_samples,
+                "staging_depth": agg.staging_depth,
+            },
+            "transport": agg.transport_stats(),
+        }
+        wheel = self.retention
+        if wheel is not None:
+            dump["query"] = {
+                "snapshot_hits": wheel.query_snapshot_hits,
+                "fallbacks": wheel.query_fallbacks,
+                "result_cache_hits": wheel.query_result_cache_hits,
+                "rows_fetched": wheel.query_rows_fetched,
+                "plan_cache_hits": wheel.plan_cache.hits,
+                "plan_cache_misses": wheel.plan_cache.misses,
+                "snapshot_age_intervals": wheel.snapshot_age_intervals(),
+            }
+        if self.committer is not None:
+            dump["commit"] = {
+                "intervals_committed": self.committer.intervals_committed,
+                "fused_intervals": self.committer.fused_intervals,
+                "fanout_intervals": self.committer.fanout_intervals,
+                "staging_depth": self.committer._staging.depth,
+            }
+        dump["obs"] = {
+            "enabled": self.obs is not None,
+            "capacity": self.obs.capacity if self.obs else 0,
+            "recorded": self.obs.recorded if self.obs else 0,
+            "dropped": self.obs.dropped if self.obs else 0,
+            "current_seq": self.obs.current_seq if self.obs else 0,
+        }
+        dump["health"] = (
+            self.health.report().as_dict() if self.health else None
+        )
+        return dump
 
     def record_batch(self, ids: np.ndarray, values: np.ndarray) -> None:
         """Batched firehose ingestion straight to the device accumulator

@@ -54,6 +54,13 @@ def dump_line(raw: RawMetricSet) -> str:
     # same format version, and old lines replay with duration=None.
     if raw.duration is not None:
         obj["interval"] = raw.duration
+    # interval sequence number (observability correlation id): lets a
+    # replayed interval line up with span records / Perfetto flows from
+    # the run that wrote it.  Optional key like "interval" — same format
+    # version, and old lines replay with seq=None (the committer mints a
+    # local seq for them).
+    if raw.seq is not None:
+        obj["seq"] = raw.seq
     return json.dumps(obj, separators=(",", ":"))
 
 
@@ -79,6 +86,9 @@ def parse_line(line: str) -> RawMetricSet:
         duration=(
             float(obj["interval"]) if obj.get("interval") is not None
             else None
+        ),
+        seq=(
+            int(obj["seq"]) if obj.get("seq") is not None else None
         ),
     )
 

@@ -47,6 +47,7 @@ import numpy as np
 from loghisto_tpu.config import MetricConfig
 from loghisto_tpu.channel import ChannelClosed, ResilientSubscription
 from loghisto_tpu.metrics import MetricSystem, RawMetricSet
+from loghisto_tpu.obs.spans import NULL_RECORDER
 from loghisto_tpu.ops.stats import make_snapshot_query_fn
 from loghisto_tpu.ops.window import (
     make_window_snapshot_fn,
@@ -293,6 +294,10 @@ class TimeWheel:
         self._sub: Optional[ResilientSubscription] = None
         self._thread: Optional[threading.Thread] = None
 
+        # observability (ISSUE 9): tier-push / hook / query-serve spans;
+        # swapped for a real ring by TPUMetricSystem(observability=...)
+        self.obs_recorder = NULL_RECORDER
+
     # -- sizing --------------------------------------------------------- #
 
     def hbm_bytes(self) -> int:
@@ -367,21 +372,23 @@ class TimeWheel:
         cell arrays are built once per interval, not once per consumer;
         hooks are NOT run (the committer owns the interval tail — plain
         ``push`` runs them)."""
-        with self._lock:
-            self._note_interval_locked(raw.time, cells)
-            for tier in self._tiers:
-                self._tier_push_locked(tier, cells, raw.rates, dur)
-            self._refresh_snapshot_locked()
+        with self.obs_recorder.span("window.tier_push", raw.seq):
+            with self._lock:
+                self._note_interval_locked(raw.time, cells)
+                for tier in self._tiers:
+                    self._tier_push_locked(tier, cells, raw.rates, dur)
+                self._refresh_snapshot_locked()
 
     def run_hooks(self, raw: RawMetricSet) -> None:
         """Fire the per-interval hooks (rule engine etc.) for ``raw`` —
         split out so the fused committer can run them after its own
         commit path."""
-        for hook in list(self._hooks):
-            try:
-                hook(raw)
-            except Exception:
-                logger.exception("timewheel interval hook failed")
+        with self.obs_recorder.span("window.hooks", raw.seq):
+            for hook in list(self._hooks):
+                try:
+                    hook(raw)
+                except Exception:
+                    logger.exception("timewheel interval hook failed")
 
     def _note_interval_locked(self, time, cells) -> None:
         """Interval-level bookkeeping shared by push_cells and the fused
@@ -648,16 +655,19 @@ class TimeWheel:
         if not 0 <= ti < len(self._tiers):
             raise ValueError(f"tier {ti} out of range")
 
-        snap = self._snapshot  # atomic ref read; handle is immutable
-        view = None
-        if self.snapshots_enabled and snap is not None:
-            view = snap.tiers[ti].view_for(window)
-        if view is None:
-            if self.snapshots_enabled:
-                self.pin_window(window)
-            self.query_fallbacks += 1
-            return self._query_recompute(pattern, window, ps, ti)
-        return self._query_snapshot(pattern, window, ps, ti, snap, view)
+        # query serving attributes to the latest landed interval (the
+        # snapshot it reads is that commit's published handle)
+        with self.obs_recorder.span("query.serve"):
+            snap = self._snapshot  # atomic ref read; handle is immutable
+            view = None
+            if self.snapshots_enabled and snap is not None:
+                view = snap.tiers[ti].view_for(window)
+            if view is None:
+                if self.snapshots_enabled:
+                    self.pin_window(window)
+                self.query_fallbacks += 1
+                return self._query_recompute(pattern, window, ps, ti)
+            return self._query_snapshot(pattern, window, ps, ti, snap, view)
 
     def _query_snapshot(
         self, pattern: str, window: float, ps: tuple, ti: int,

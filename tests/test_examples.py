@@ -127,3 +127,21 @@ def test_migrate_from_go_example_runs():
     assert values.get("range_splits") == 1.0
     assert values.get("some_ipc_latency_99.9", 0.0) > 0
     assert values.get("sys.NumGoroutine", 0.0) >= 1
+
+
+def test_pipeline_trace_example_runs():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "pipeline_trace.py")],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    assert "health: ok (HTTP 200)" in out
+    # the induced stall surfaces with a machine-readable reason and a
+    # failing status code (ISSUE 9 acceptance)
+    assert "health: stalled (HTTP 503)" in out
+    assert "reason: no_commit" in out
+    assert "recovered: ok (HTTP 200)" in out
+    # the span ring decomposed the commit, and the Perfetto dump landed
+    assert "commit.e2e" in out
+    assert "perfetto:" in out and "events" in out

@@ -27,6 +27,21 @@ def test_dump_parse_roundtrip():
     assert back.time == raw.time
 
 
+def test_seq_roundtrips_and_old_lines_replay_without_it():
+    ms, raw = _sample_raw()
+    assert raw.seq is not None  # minted by the reaper at collection
+    back = journal.parse_line(journal.dump_line(raw))
+    assert back.seq == raw.seq
+    # a pre-seq line (same format version, no "seq" key) still parses
+    import json
+
+    obj = json.loads(journal.dump_line(raw))
+    del obj["seq"]
+    old = journal.parse_line(json.dumps(obj))
+    assert old.seq is None
+    assert old.counters == raw.counters
+
+
 def test_replay_feeds_processing_and_device(tmp_path):
     ms, raw = _sample_raw()
     path = str(tmp_path / "j.jsonl")

@@ -1,0 +1,106 @@
+"""utils/trace.py: LOGHISTO_TRACE_DIR env routing in maybe_capture,
+profile_region annotation, capture start/stop pairing (including on
+exceptions), and nesting order.  jax.profiler is monkeypatched — these
+are wiring tests, not profiler integration tests."""
+
+import os
+
+import pytest
+
+import jax.profiler
+
+from loghisto_tpu.utils import trace
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture
+def profiler_log(monkeypatch):
+    """Replace jax.profiler's trace entry points with call recorders."""
+    calls = []
+    monkeypatch.setattr(
+        jax.profiler, "start_trace",
+        lambda path: calls.append(("start", path)),
+    )
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace", lambda: calls.append(("stop",))
+    )
+
+    class FakeAnnotation:
+        def __init__(self, name):
+            self.name = name
+
+        def __enter__(self):
+            calls.append(("annot_enter", self.name))
+            return self
+
+        def __exit__(self, *exc):
+            calls.append(("annot_exit", self.name))
+
+    monkeypatch.setattr(jax.profiler, "TraceAnnotation", FakeAnnotation)
+    return calls
+
+
+def test_profile_region_annotates(profiler_log):
+    with trace.profile_region("ingest"):
+        profiler_log.append(("body",))
+    assert profiler_log == [
+        ("annot_enter", "ingest"), ("body",), ("annot_exit", "ingest"),
+    ]
+
+
+def test_capture_pairs_start_stop(profiler_log):
+    with trace.capture("/tmp/t"):
+        profiler_log.append(("body",))
+    assert profiler_log == [("start", "/tmp/t"), ("body",), ("stop",)]
+
+
+def test_capture_stops_trace_on_exception(profiler_log):
+    with pytest.raises(RuntimeError):
+        with trace.capture("/tmp/t"):
+            raise RuntimeError("boom")
+    assert profiler_log == [("start", "/tmp/t"), ("stop",)]
+
+
+def test_maybe_capture_routes_to_capture_when_env_set(
+    profiler_log, monkeypatch, tmp_path
+):
+    monkeypatch.setenv("LOGHISTO_TRACE_DIR", str(tmp_path))
+    with trace.maybe_capture("collect"):
+        pass
+    assert profiler_log == [
+        ("start", os.path.join(str(tmp_path), "collect")), ("stop",),
+    ]
+
+
+def test_maybe_capture_routes_to_annotation_when_env_unset(
+    profiler_log, monkeypatch
+):
+    monkeypatch.delenv("LOGHISTO_TRACE_DIR", raising=False)
+    with trace.maybe_capture("collect"):
+        pass
+    assert profiler_log == [
+        ("annot_enter", "collect"), ("annot_exit", "collect"),
+    ]
+
+
+def test_maybe_capture_treats_empty_env_as_unset(profiler_log, monkeypatch):
+    monkeypatch.setenv("LOGHISTO_TRACE_DIR", "")
+    with trace.maybe_capture("collect"):
+        pass
+    assert ("annot_enter", "collect") in profiler_log
+    assert not any(c[0] == "start" for c in profiler_log)
+
+
+def test_profile_region_nests_inside_capture(profiler_log, monkeypatch):
+    monkeypatch.setenv("LOGHISTO_TRACE_DIR", "/tmp/traces")
+    with trace.maybe_capture("outer"):
+        with trace.profile_region("inner"):
+            profiler_log.append(("body",))
+    assert profiler_log == [
+        ("start", "/tmp/traces/outer"),
+        ("annot_enter", "inner"),
+        ("body",),
+        ("annot_exit", "inner"),
+        ("stop",),
+    ]
