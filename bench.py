@@ -554,6 +554,28 @@ def main() -> None:
         print(f"bench: obs-overhead stage failed: {e}", file=sys.stderr)
     ready7.set()
 
+    # crash-recovery headline (benchmarks/recovery_bench.py has the
+    # full durability table): wall time to restore a checkpoint and
+    # replay the journal suffix through the real commit path, and the
+    # commit-loop cost of the chaos hook points with no injector
+    # attached (< 1% budget; measured via an attached-but-idle
+    # injector, a strict upper bound on the disabled None check).
+    ready8 = _start_watchdog(300.0, on_timeout=lambda: print(
+        json.dumps(result), flush=True
+    ))
+    try:
+        from benchmarks.recovery_bench import run as recovery_run
+
+        rcv = recovery_run(reps=3, intervals=32, commits=60)
+        result["recovery_time_ms"] = rcv["recovery_time_ms"]
+        result["faults_disabled_overhead_pct"] = (
+            rcv["faults_disabled_overhead_pct"]
+        )
+        result["recovery_suspect"] = rcv["suspect"]
+    except Exception as e:  # never let the extra metric kill the bench
+        print(f"bench: recovery stage failed: {e}", file=sys.stderr)
+    ready8.set()
+
     print(json.dumps(result))
 
 

@@ -196,6 +196,17 @@ class IntervalCommitter:
         self.self_observer = None
         self.watchdog = None
 
+        # resilience (ISSUE 10), installed by TPUMetricSystem
+        # (resilience=...): the supervisor respawns a crashed bridge,
+        # the breaker pins the fan-out/spill path after repeated device
+        # failures, the injector scripts chaos faults (None = one
+        # attribute test per site), and the recovery manager checkpoints
+        # on the bridge cadence
+        self.supervisor = None
+        self.breaker = None
+        self.fault_injector = None
+        self.recovery = None
+
         self._ms: Optional[MetricSystem] = None
         self._sub: Optional[ResilientSubscription] = None
         self._thread: Optional[threading.Thread] = None
@@ -309,6 +320,10 @@ class IntervalCommitter:
             # dogfooding: this interval's closed spans re-enter through
             # the normal histogram() path as obs.<stage>.LatencyUs
             self.self_observer.on_interval(seq)
+        if self.recovery is not None:
+            # watermark + cadenced checkpoint ride the bridge thread,
+            # never the ingest path (resilience/recovery.py)
+            self.recovery.on_commit(raw)
         return mode
 
     def _commit_cells(self, cells, raw: RawMetricSet, dur: float):
@@ -316,9 +331,15 @@ class IntervalCommitter:
         agg, wheel = self.aggregator, self.wheel
         ids, bidx64, w64 = cells
         total = int(w64.sum(dtype=np.int64))
+        # an open breaker pins the fan-out/spill path: after repeated
+        # device failures every fused attempt costs a donated-carry
+        # rebuild, so stop attempting until the open window passes and a
+        # half-open trial succeeds (resilience/recovery.py)
+        pinned = self.breaker is not None and self.breaker.is_open()
         with agg._dev_lock:
             if (
-                agg._interval_ingested + total >= agg.spill_threshold
+                pinned
+                or agg._interval_ingested + total >= agg.spill_threshold
                 or int(w64.max()) >= 1 << 30
             ):
                 # int32-overflow envelope exceeded: the aggregator side
@@ -414,7 +435,13 @@ class IntervalCommitter:
         payloads = acc_payload = None
         try:
             rec = self.obs_recorder
+            inj = self.fault_injector
             for off in range(0, n, self.chunk):
+                if inj is not None:
+                    # chaos hook: a scripted device failure fires inside
+                    # the try so _on_fused_failure_locked recovers it
+                    # exactly like an organic dispatch failure
+                    inj.check("commit.dispatch")
                 take = min(self.chunk, n - off)
                 with rec.span("commit.upload"):
                     dev_ids, dev_idx, dev_w = self._staging.stage(
@@ -479,6 +506,12 @@ class IntervalCommitter:
                 # next (a device failure here takes the normal recovery)
                 with rec.span("commit.device_sync"):
                     jax.block_until_ready(agg._acc)
+            if self.breaker is not None:
+                # closes a half-open breaker after a successful trial;
+                # failures are recorded in ONE place (the aggregator's
+                # _on_device_failure_locked) so fan-out hooks can't
+                # multi-count a single physical failure
+                self.breaker.record_success()
         except Exception:
             payloads = acc_payload = None
             reset_tiers = self._on_fused_failure_locked(
@@ -656,6 +689,12 @@ class IntervalCommitter:
                     raw = sub.get()
                 except ChannelClosed:
                     return
+                inj = self.fault_injector
+                if inj is not None:
+                    # chaos hook OUTSIDE the per-commit net: a scripted
+                    # bridge crash escapes to the supervisor's restart
+                    # loop (the per-commit except would swallow it)
+                    inj.check("commit.bridge")
                 try:
                     self.commit(raw)
                 except Exception:  # pragma: no cover - defensive
@@ -663,16 +702,26 @@ class IntervalCommitter:
                         "fused interval commit failed for %s", raw.time
                     )
 
-        self._thread = threading.Thread(
-            target=bridge, daemon=True, name="loghisto-commit"
-        )
-        self._thread.start()
+        if self.supervisor is not None:
+            # crashed bridges restart with capped backoff; a clean
+            # ChannelClosed return (detach) ends the thread for good
+            self._thread = self.supervisor.spawn(bridge, "loghisto-commit")
+        else:
+            self._thread = threading.Thread(
+                target=bridge, daemon=True, name="loghisto-commit"
+            )
+            self._thread.start()
 
     def detach(self) -> None:
         if self._sub is not None:
             self._sub.close()
             self._sub = None
         if self._thread is not None:
+            # a supervised handle also needs its restart loop stopped —
+            # otherwise a backoff nap could outlive the join below
+            stop = getattr(self._thread, "stop", None)
+            if stop is not None:
+                stop()
             self._thread.join(timeout=5.0)
             self._thread = None
 

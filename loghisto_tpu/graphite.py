@@ -74,5 +74,27 @@ def make_graphite_serializer(
     return serialize
 
 
+def push_graphite(
+    address: tuple[str, int],
+    metric_set: ProcessedMetricSet,
+    prefix: str = "cockroach",
+    hostname: str | None = None,
+    tags: Optional[Mapping[str, str]] = None,
+    attempts: int = 3,
+    backoff=None,
+) -> Optional[Exception]:
+    """Serialize and deliver one metric set to a Carbon instance with
+    the shared capped-exponential-backoff retry policy
+    (resilience/backoff.py).  Returns the last error or None — the
+    one-shot push path that previously had to hand-roll its own retry
+    loop around send_once."""
+    from loghisto_tpu.resilience.backoff import send_with_backoff
+
+    payload = graphite_protocol(metric_set, prefix, hostname, tags)
+    return send_with_backoff(
+        "tcp", address, payload, attempts=attempts, backoff=backoff
+    )
+
+
 # Reference-style alias: usable directly as a Submitter serializer.
 GraphiteProtocol = graphite_protocol

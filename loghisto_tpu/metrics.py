@@ -1107,11 +1107,22 @@ class MetricSystem:
             if self._reaper_thread is not None and self._reaper_thread.is_alive():
                 return
             self._shutdown = threading.Event()
-            self._reaper_thread = threading.Thread(
-                target=self._reaper, args=(self._shutdown,),
-                daemon=True, name="loghisto-reaper",
-            )
-            self._reaper_thread.start()
+            shutdown = self._shutdown
+            supervisor = getattr(self, "supervisor", None)
+            if supervisor is not None:
+                # resilience (ISSUE 10): a crashed reaper restarts with
+                # capped backoff on the same shutdown event — metric
+                # collection survives a generation's crash instead of
+                # going quiet for the process lifetime
+                self._reaper_thread = supervisor.spawn(
+                    lambda: self._reaper(shutdown), "loghisto-reaper"
+                )
+            else:
+                self._reaper_thread = threading.Thread(
+                    target=self._reaper, args=(shutdown,),
+                    daemon=True, name="loghisto-reaper",
+                )
+                self._reaper_thread.start()
 
     def stop(self) -> None:
         """Shut the reaper and worker pool down (metrics.go:651-653).
@@ -1120,6 +1131,11 @@ class MetricSystem:
             self._shutdown.set()
             t = self._reaper_thread
         if t is not None and t is not threading.current_thread():
+            # a supervised handle's restart loop must stop too, or a
+            # backoff nap could outlive the join below
+            stop_fn = getattr(t, "stop", None)
+            if stop_fn is not None:
+                stop_fn()
             t.join(timeout=5.0)
 
     # Go-style aliases for drop-in familiarity with the reference API.

@@ -28,6 +28,12 @@ Invariants evaluated (each yields a machine-readable reason dict
     (or any subscriber) was strike-evicted recently; data holes follow.
   * ``device_cooldown``      — the aggregator is inside its
     device-failure retry cooldown, replaying/rebuilding device state.
+  * ``thread_restarted``     — a supervised pipeline thread crashed and
+    was restarted with backoff (ISSUE 10; latched one stall window).
+  * ``breaker_open``         — the device circuit breaker is open or
+    half-open; intervals take the pinned fan-out/spill path.
+  * ``recovery_in_progress`` — checkpoint restore + journal replay is
+    rebuilding state after a crash.
 
 ``no_commit`` makes the report STALLED; every other reason makes it
 DEGRADED; otherwise OK.  Event-shaped invariants (fan-outs, evictions)
@@ -93,10 +99,18 @@ class HealthWatchdog:
         commit_path: Optional[str] = None,
         commit_path_reason: Optional[str] = None,
         wheel=None,
+        supervisor=None,
+        breaker=None,
+        recovery=None,
     ):
         self._committer = committer
         self._agg = aggregator
         self._wheel = wheel
+        # resilience (ISSUE 10): restart ledger, device circuit breaker,
+        # recovery manager — each optional, each adds one invariant
+        self._supervisor = supervisor
+        self._breaker = breaker
+        self._recovery = recovery
         self.interval = float(interval)
         self.stall_intervals = float(stall_intervals)
         self.backpressure_fraction = float(backpressure_fraction)
@@ -115,6 +129,10 @@ class HealthWatchdog:
         self._fanout_until = 0.0
         self._ev_seen = int(getattr(committer, "bridge_evictions", 0))
         self._ev_until = 0.0
+        self._restarts_seen = int(
+            getattr(supervisor, "total_restarts", 0) or 0
+        )
+        self._restarts_until = 0.0
         # fan-out systems have no committer calling note_commit; fall
         # back to observing the wheel's interval counter at read time
         self._pushed_seen = int(getattr(wheel, "intervals_pushed", 0) or 0)
@@ -221,6 +239,47 @@ class HealthWatchdog:
                 "value": float(evictions),
             })
 
+        if self._supervisor is not None:
+            # event latch like fan-outs/evictions: a restart stays
+            # visible for one stall window
+            restarts = int(self._supervisor.total_restarts)
+            if restarts > self._restarts_seen:
+                self._restarts_seen = restarts
+                self._restarts_until = now + self._latch_window
+            if now < self._restarts_until:
+                reasons.append({
+                    "code": "thread_restarted",
+                    "detail": (
+                        "a supervised pipeline thread crashed and was "
+                        "restarted with backoff "
+                        f"({dict(self._supervisor.restarts_by_name)})"
+                    ),
+                    "value": float(restarts),
+                })
+
+        if self._breaker is not None and self._breaker.state != "closed":
+            # live state, not a latch: the breaker holds open/half-open
+            # on its own clock until a trial dispatch succeeds
+            reasons.append({
+                "code": "breaker_open",
+                "detail": (
+                    f"device circuit breaker is {self._breaker.state} "
+                    f"after {self._breaker.failures_total} failure(s); "
+                    "intervals take the pinned fan-out/spill path"
+                ),
+                "value": float(self._breaker.opened_total),
+            })
+
+        if self._recovery is not None and self._recovery.in_progress:
+            reasons.append({
+                "code": "recovery_in_progress",
+                "detail": (
+                    "checkpoint restore + journal replay is rebuilding "
+                    "pipeline state; queries may see partial history"
+                ),
+                "value": 1.0,
+            })
+
         down_until = float(getattr(agg, "_device_down_until", 0.0) or 0.0)
         if down_until > now:
             reasons.append({
@@ -264,7 +323,9 @@ class HealthWatchdog:
         )
         for code in ("no_commit", "ingest_backpressure",
                      "transfer_drain_lag", "fused_degraded",
-                     "subscriber_evictions", "device_cooldown"):
+                     "subscriber_evictions", "device_cooldown",
+                     "thread_restarted", "breaker_open",
+                     "recovery_in_progress"):
             ms.register_gauge_func(
                 f"health.{code}",
                 lambda c=code: float(c in self.report().reason_codes()),

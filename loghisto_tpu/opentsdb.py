@@ -39,5 +39,24 @@ def opentsdb_protocol(
     return "".join(lines).encode()
 
 
+def push_opentsdb(
+    address: tuple[str, int],
+    metric_set: ProcessedMetricSet,
+    tags: Mapping[str, str] | None = None,
+    hostname: str | None = None,
+    attempts: int = 3,
+    backoff=None,
+) -> "Exception | None":
+    """Serialize and deliver one metric set to an OpenTSDB/KairosDB
+    instance with the shared capped-exponential-backoff retry policy
+    (resilience/backoff.py).  Returns the last error or None."""
+    from loghisto_tpu.resilience.backoff import send_with_backoff
+
+    payload = opentsdb_protocol(metric_set, tags, hostname)
+    return send_with_backoff(
+        "tcp", address, payload, attempts=attempts, backoff=backoff
+    )
+
+
 # Reference-style alias: usable directly as a Submitter serializer.
 OpenTSDBProtocol = opentsdb_protocol

@@ -576,6 +576,14 @@ class TPUAggregator:
         # dispatch; None whenever the accumulator was reset, grown,
         # spilled, or rebuilt — readers must treat None as "recompute"
         self.stats_snapshot = None
+        # resilience (ISSUE 10), installed by TPUMetricSystem: the
+        # supervisor ledgers bridge/worker restarts, the breaker counts
+        # device failures (ONE count point: _on_device_failure_locked),
+        # the injector scripts chaos faults (None = one attribute test
+        # per hook site)
+        self.supervisor = None
+        self.device_breaker = None
+        self.fault_injector = None
         # observability (ISSUE 9): flush/drain spans; swapped for a real
         # ring by TPUMetricSystem(observability=...)
         self.obs_recorder = NULL_RECORDER
@@ -1160,6 +1168,18 @@ class TPUAggregator:
         queue, lazily (re)spawning the worker thread."""
         with self._xfer_cv:
             if self._xfer_thread is None or not self._xfer_thread.is_alive():
+                if (
+                    self._xfer_thread is not None
+                    and not self._xfer_stop
+                    and self.supervisor is not None
+                ):
+                    # the worker died abnormally (a clean close() sets
+                    # _xfer_stop first); the lazy respawn below is its
+                    # restart — count it on the shared ledger so the
+                    # thread_restarted invariant sees it
+                    self.supervisor.note_external_restart(
+                        "loghisto-tpu-xfer"
+                    )
                 self._xfer_stop = False
                 self._xfer_thread = threading.Thread(
                     target=self._xfer_worker,
@@ -1207,6 +1227,14 @@ class TPUAggregator:
 
     def _xfer_worker(self) -> None:
         while True:
+            inj = self.fault_injector
+            if inj is not None:
+                # chaos hook BETWEEN items (no queue bookkeeping is in
+                # flight here): a scripted crash kills the worker — the
+                # next enqueue lazily respawns it, counted on the
+                # supervisor ledger; a scripted wedge blocks it, backing
+                # the queue up into the max_pending_samples shed bound
+                inj.check("agg.xfer_worker")
             with self._xfer_cv:
                 while not self._xfer_queue and not self._xfer_stop:
                     self._xfer_cv.wait()
@@ -1364,6 +1392,12 @@ class TPUAggregator:
                 for off in range(soff, send, bs):
                     lo = off - soff
                     try:
+                        inj = self.fault_injector
+                        if inj is not None:
+                            # chaos hook inside the per-chunk net: an
+                            # injected device failure takes the organic
+                            # recovery (cooldown + requeue remainder)
+                            inj.check("agg.ingest")
                         self._acc = self._ingest(
                             self._acc,
                             ids_dev[lo:lo + bs],
@@ -1542,6 +1576,12 @@ class TPUAggregator:
             self._interval_ingested = 0
             self._acc = self._fresh_acc()
         self.stats_snapshot = None
+        if self.device_breaker is not None:
+            # the SINGLE breaker count point per physical failure: the
+            # committer's fused recovery, the bridge merge, and the
+            # transfer worker all funnel through this handler, so the
+            # consumer hooks fanning out from here must never count
+            self.device_breaker.record_failure("aggregator")
 
     # -- host-tier bridge ----------------------------------------------- #
 
@@ -1723,10 +1763,15 @@ class TPUAggregator:
                         "device merge failed for interval %s", raw.time
                     )
 
-        t = threading.Thread(
-            target=bridge, daemon=True, name="loghisto-tpu-bridge"
-        )
-        t.start()
+        if self.supervisor is not None:
+            # a crashed bridge restarts with capped backoff; the clean
+            # stop-event return ends the thread for good
+            t = self.supervisor.spawn(bridge, "loghisto-tpu-bridge")
+        else:
+            t = threading.Thread(
+                target=bridge, daemon=True, name="loghisto-tpu-bridge"
+            )
+            t.start()
         self._attached = (ms, t)
 
     def detach(self) -> None:
@@ -1740,6 +1785,11 @@ class TPUAggregator:
         if ch is not None:
             ms.unsubscribe_from_raw_metrics(ch)
             ch.close()
+        # a supervised handle also needs its restart loop stopped, or a
+        # backoff nap could outlive the join below
+        stop_fn = getattr(t, "stop", None)
+        if stop_fn is not None:
+            stop_fn()
         t.join(timeout=5.0)
         self._attached = None
 

@@ -1,0 +1,33 @@
+"""Resilience subsystem: fault injection, crash-safe recovery, thread
+supervision, circuit breaking (ISSUE 10).
+
+    from loghisto_tpu.resilience import ResilienceConfig, FaultInjector
+    ms = TPUMetricSystem(..., resilience=ResilienceConfig(
+        checkpoint_path="state.npz", journal_path="intervals.jsonl"))
+    ms.recover()   # restore + replay: at most one interval lost
+"""
+
+from loghisto_tpu.resilience.backoff import Backoff, send_with_backoff
+from loghisto_tpu.resilience.faults import FaultInjector, InjectedFault
+from loghisto_tpu.resilience.recovery import (
+    CircuitBreaker,
+    RecoveryManager,
+    RecoveryReport,
+    ResilienceConfig,
+    register_resilience_gauges,
+)
+from loghisto_tpu.resilience.supervise import SupervisedThread, ThreadSupervisor
+
+__all__ = [
+    "Backoff",
+    "CircuitBreaker",
+    "FaultInjector",
+    "InjectedFault",
+    "RecoveryManager",
+    "RecoveryReport",
+    "ResilienceConfig",
+    "SupervisedThread",
+    "ThreadSupervisor",
+    "register_resilience_gauges",
+    "send_with_backoff",
+]

@@ -74,6 +74,7 @@ class Submitter:
         destination_address: tuple[str, int],
         backlog_slots: int = BACKLOG_SLOTS,
         dial_timeout: float = DIAL_TIMEOUT_S,
+        backoff=None,
     ):
         if destination_network not in ("tcp", "udp"):
             raise ValueError("destination_network must be 'tcp' or 'udp'")
@@ -82,6 +83,21 @@ class Submitter:
         self.destination_network = destination_network
         self.destination_address = destination_address
         self.dial_timeout = dial_timeout
+        # shared capped-exponential retry cadence: a dead TSDB is re-poked
+        # at growing intervals (capped at the metric interval) instead of
+        # every interval boundary; the first success snaps back to the
+        # interval cadence (resilience/backoff.py)
+        if backoff is None:
+            from loghisto_tpu.resilience.backoff import Backoff
+
+            backoff = Backoff(
+                base_s=min(1.0, metric_system.interval / 4.0 or 0.25),
+                cap_s=max(metric_system.interval, 1.0),
+            )
+        self._backoff = backoff
+        self.send_failures = 0
+        # chaos hook: scripted export failures ("export.send")
+        self.fault_injector = None
         self._backlog: deque[bytes] = deque(maxlen=backlog_slots)
         self._backlog_lock = threading.Lock()
         # survives strike-eviction: one transient stall must not kill the
@@ -121,10 +137,20 @@ class Submitter:
     def submit(self, request: bytes) -> Optional[Exception]:
         """One best-effort delivery: fresh dial, write, close
         (reference submitter.go:106-116).  Returns the error, if any."""
-        return send_once(
+        inj = self.fault_injector
+        if inj is not None:
+            try:
+                inj.check("export.send")
+            except Exception as e:  # injected failures follow the
+                self.send_failures += 1  # send_once error contract
+                return e
+        err = send_once(
             self.destination_network, self.destination_address, request,
             self.dial_timeout,
         )
+        if err is not None:
+            self.send_failures += 1
+        return err
 
     # -- lifecycle ------------------------------------------------------ #
 
@@ -149,8 +175,29 @@ class Submitter:
             err = self.retry_backlog()
             if err is not None:
                 logger.debug("metric submission failed: %s", err)
-            tts = interval - (time.time() % interval)
+                # failed sends re-poke on the capped-exponential cadence
+                tts = self._backoff.next_delay()
+            else:
+                self._backoff.reset()
+                tts = interval - (time.time() % interval)
             self._shutdown.wait(timeout=tts)
+
+    def backlog_depth(self) -> int:
+        with self._backlog_lock:
+            return len(self._backlog)
+
+    def register_gauges(self, ms: Optional[MetricSystem] = None) -> None:
+        """Export-path health on the ordinary gauge pipeline."""
+        ms = ms if ms is not None else self.metric_system
+        ms.register_gauge_func(
+            "export.RetryBackoffMs", lambda: float(self._backoff.current_ms)
+        )
+        ms.register_gauge_func(
+            "export.SendFailures", lambda: float(self.send_failures)
+        )
+        ms.register_gauge_func(
+            "export.BacklogDepth", lambda: float(self.backlog_depth())
+        )
 
     def start(self) -> None:
         """Spawn the receive/serialize and send/retry threads

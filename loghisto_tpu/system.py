@@ -53,6 +53,7 @@ class TPUMetricSystem(MetricSystem):
         anomaly=None,
         transport: str = "auto",
         observability=None,
+        resilience=None,
     ):
         """``retention`` turns on the windowed retention tier:
         ``True`` builds a TimeWheel with the default 60x1 / 60x60 /
@@ -107,11 +108,55 @@ class TPUMetricSystem(MetricSystem):
         the Prometheus endpoint's ``/healthz`` JSON, and the span ring
         dumps as Perfetto-compatible Chrome trace JSON
         (``obs.dump_perfetto(ms.obs, path)``).  ``debug_dump()`` works
-        with or without it."""
+        with or without it.
+
+        ``resilience`` takes a ``resilience.ResilienceConfig`` (or
+        ``True`` for the defaults) and turns on the resilience subsystem
+        (ISSUE 10): pipeline bridge threads (reaper, committer bridge,
+        aggregator bridge, time-wheel bridge) restart with capped
+        exponential backoff instead of silently dying; repeated device
+        failures trip a circuit breaker that pins the fan-out/spill
+        commit path; with ``checkpoint_path``/``journal_path`` set, the
+        committer bridge checkpoints every N intervals (stamped with the
+        interval seq watermark) and ``recover()`` restores + replays the
+        journal past the watermark — at most one interval lost across a
+        crash.  A ``fault_injector`` in the config scripts deterministic
+        chaos faults through the pipeline's hook sites; left None, every
+        hook is a single attribute test."""
         super().__init__(
             interval=interval, sys_stats=sys_stats, config=config,
             fast_ingest=fast_ingest,
         )
+
+        # -- resilience (ISSUE 10), resolved FIRST so every component
+        # below is constructed/attached already wired ------------------- #
+        self.resilience = None
+        self.fault_injector = None
+        self.supervisor = None     # the reaper's start() picks this up
+        self.device_breaker = None
+        self.recovery = None
+        self._recovered = False
+        if resilience is not None and resilience is not False:
+            from loghisto_tpu.resilience import (
+                CircuitBreaker, ResilienceConfig, ThreadSupervisor,
+            )
+
+            rcfg = (
+                ResilienceConfig() if resilience is True else resilience
+            )
+            self.resilience = rcfg
+            self.fault_injector = rcfg.fault_injector
+            if rcfg.supervise:
+                self.supervisor = ThreadSupervisor(
+                    base_backoff_s=rcfg.restart_backoff_s,
+                    max_backoff_s=rcfg.restart_backoff_cap_s,
+                )
+            self.device_breaker = CircuitBreaker(
+                threshold=rcfg.breaker_threshold,
+                window_s=rcfg.breaker_window_s,
+                open_s=rcfg.breaker_open_s,
+            )
+
         self.aggregator = TPUAggregator(
             num_metrics=num_metrics,
             config=config,
@@ -121,6 +166,11 @@ class TPUMetricSystem(MetricSystem):
             transport=transport,
         )
         self.aggregator.register_device_gauges(self)
+        if self.resilience is not None:
+            # before attach: the bridge/xfer threads must spawn supervised
+            self.aggregator.supervisor = self.supervisor
+            self.aggregator.device_breaker = self.device_breaker
+            self.aggregator.fault_injector = self.fault_injector
 
         self.retention = None
         self.rule_engine = None
@@ -144,6 +194,9 @@ class TPUMetricSystem(MetricSystem):
                     registry=self.aggregator.registry,
                     mesh=mesh,
                 )
+            if self.resilience is not None:
+                self.retention.supervisor = self.supervisor
+                self.retention.fault_injector = self.fault_injector
             self.rule_engine = RuleEngine(self.retention)
             self.rule_engine.attach()
             # query-engine self-metrics (commit.query_* family): snapshot
@@ -212,6 +265,10 @@ class TPUMetricSystem(MetricSystem):
                     lifecycle=self.lifecycle,
                     anomaly=self.anomaly,
                 )
+                if self.resilience is not None:
+                    self.committer.supervisor = self.supervisor
+                    self.committer.breaker = self.device_breaker
+                    self.committer.fault_injector = self.fault_injector
                 self.committer.attach(self)
                 self.committer.register_gauges(self)
             elif commit == "fused":
@@ -247,6 +304,44 @@ class TPUMetricSystem(MetricSystem):
             self.aggregator.attach(self)
             if self.retention is not None:
                 self.retention.attach(self)
+
+        if self.resilience is not None:
+            from loghisto_tpu.resilience import (
+                RecoveryManager, register_resilience_gauges,
+            )
+
+            rcfg = self.resilience
+            if (rcfg.checkpoint_path is not None
+                    or rcfg.journal_path is not None):
+                self.recovery = RecoveryManager(
+                    self,
+                    aggregator=self.aggregator,
+                    committer=self.committer,
+                    lifecycle=self.lifecycle,
+                    anomaly=self.anomaly,
+                    checkpoint_path=rcfg.checkpoint_path,
+                    journal_path=rcfg.journal_path,
+                    checkpoint_every_intervals=(
+                        rcfg.checkpoint_every_intervals
+                    ),
+                    fault_injector=self.fault_injector,
+                )
+                if self.committer is not None:
+                    # the bridge thread drives the checkpoint cadence
+                    self.committer.recovery = self.recovery
+                elif self.retention is not None:
+                    # fan-out path: the wheel's interval hook is the
+                    # per-interval heartbeat instead
+                    self.retention.add_interval_hook(
+                        lambda raw, _rec=self.recovery: _rec.on_commit(raw)
+                    )
+            register_resilience_gauges(
+                self,
+                supervisor=self.supervisor,
+                breaker=self.device_breaker,
+                recovery=self.recovery,
+                injector=self.fault_injector,
+            )
 
         # -- self-observability (ISSUE 9) ------------------------------- #
         self.obs = None            # the SpanRecorder (None when off)
@@ -291,6 +386,9 @@ class TPUMetricSystem(MetricSystem):
                     commit_path=self.commit_path,
                     commit_path_reason=self.commit_path_reason,
                     wheel=self.retention,
+                    supervisor=self.supervisor,
+                    breaker=self.device_breaker,
+                    recovery=self.recovery,
                 )
                 if self.committer is not None:
                     self.committer.watchdog = self.health
@@ -351,6 +449,41 @@ class TPUMetricSystem(MetricSystem):
             "dropped": self.obs.dropped if self.obs else 0,
             "current_seq": self.obs.current_seq if self.obs else 0,
         }
+        if self.resilience is not None:
+            dump["resilience"] = {
+                "thread_restarts": (
+                    dict(self.supervisor.restarts_by_name)
+                    if self.supervisor is not None else {}
+                ),
+                "breaker_state": (
+                    self.device_breaker.state
+                    if self.device_breaker is not None else None
+                ),
+                "breaker_opened_total": (
+                    self.device_breaker.opened_total
+                    if self.device_breaker is not None else 0
+                ),
+                "checkpoints_taken": (
+                    self.recovery.checkpoints_taken
+                    if self.recovery is not None else 0
+                ),
+                "checkpoint_errors": (
+                    self.recovery.checkpoint_errors
+                    if self.recovery is not None else 0
+                ),
+                "last_checkpoint_seq": (
+                    self.recovery.last_checkpoint_seq
+                    if self.recovery is not None else None
+                ),
+                "recovery_in_progress": (
+                    self.recovery.in_progress
+                    if self.recovery is not None else False
+                ),
+                "faults_injected": (
+                    self.fault_injector.faults_injected
+                    if self.fault_injector is not None else 0
+                ),
+            }
         dump["health"] = (
             self.health.report().as_dict() if self.health else None
         )
@@ -446,6 +579,21 @@ class TPUMetricSystem(MetricSystem):
 
     # ------------------------------------------------------------------ #
 
+    def recover(self):
+        """Restore the latest checkpoint and replay journaled intervals
+        past its seq watermark (resilience.RecoveryManager.recover) —
+        at most the one in-flight interval is lost across a crash.
+        Returns the RecoveryReport.  Runs automatically on the first
+        ``start()`` when ``ResilienceConfig.recover_on_start`` is set."""
+        if self.recovery is None:
+            raise RuntimeError(
+                "crash recovery needs a checkpoint/journal path: "
+                "construct with TPUMetricSystem(resilience="
+                "ResilienceConfig(checkpoint_path=..., journal_path=...))"
+            )
+        self._recovered = True
+        return self.recovery.recover()
+
     def start(self) -> None:
         # restartable like the base class: re-attach whichever commit
         # pipeline a previous stop() detached — the fused committer is
@@ -458,6 +606,16 @@ class TPUMetricSystem(MetricSystem):
                 self.aggregator.attach(self)
             if self.retention is not None and self.retention._thread is None:
                 self.retention.attach(self)
+        if self.recovery is not None:
+            # recover BEFORE the reaper starts minting intervals: replay
+            # runs through the normal commit path, then the seq counter
+            # is advanced past the replayed watermark so live intervals
+            # never collide with journaled ones
+            if (self.resilience.recover_on_start
+                    and not self._recovered):
+                self._recovered = True
+                self.recovery.recover()
+            self.recovery.start()
         super().start()
 
     def stop(self) -> None:
@@ -471,4 +629,9 @@ class TPUMetricSystem(MetricSystem):
         # shutdown never strands in-flight samples; the worker re-spawns
         # lazily if start() resumes ingestion
         self.aggregator.close()
+        if self.recovery is not None:
+            # after the bridges drained, before the reaper dies: the
+            # final checkpoint captures every committed interval, so a
+            # clean stop/start round trip replays nothing
+            self.recovery.stop(final_checkpoint=True)
         super().stop()

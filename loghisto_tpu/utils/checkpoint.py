@@ -25,7 +25,10 @@ import numpy as np
 
 from loghisto_tpu.metrics import MetricSystem
 
-FORMAT_VERSION = 1
+# v2: optional interval-seq watermark rides the payload so crash
+# recovery can replay ONLY journal intervals past the snapshotted state
+# (resilience/recovery.py).  v1 files load fine — watermark None.
+FORMAT_VERSION = 2
 
 
 def save(
@@ -34,8 +37,16 @@ def save(
     aggregator=None,
     lifecycle=None,
     anomaly=None,
+    seq_watermark: Optional[int] = None,
+    fault_injector=None,
 ) -> None:
     """Atomically snapshot lifetime state to `path` (.npz).
+
+    ``seq_watermark`` stamps the snapshot with the last committed
+    interval seq folded into this state; ``fault_injector`` exposes the
+    two crash-window hook sites ("checkpoint.write" before the payload
+    lands, "checkpoint.rename" after fsync but before the atomic
+    rename) for the chaos harness.
 
     ``lifecycle`` (a lifecycle.LifecycleManager) additionally persists
     the activity vector, the lifetime churn counters, and the registry
@@ -49,6 +60,8 @@ def save(
     after a restart instead of re-learning every baseline; rows are
     remapped by NAME on restore like every other per-row payload."""
     payload = {"version": np.int64(FORMAT_VERSION)}
+    if seq_watermark is not None:
+        payload["seq_watermark"] = np.int64(seq_watermark)
 
     if metric_system is not None:
         with metric_system._store_lock:
@@ -132,10 +145,14 @@ def save(
     directory = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     try:
+        if fault_injector is not None:
+            fault_injector.check("checkpoint.write")
         with os.fdopen(fd, "wb") as f:
             np.savez_compressed(f, **payload)
             f.flush()
             os.fsync(f.fileno())  # data durable before the rename
+        if fault_injector is not None:
+            fault_injector.check("checkpoint.rename")
         os.replace(tmp, path)
     except BaseException:
         try:
@@ -151,18 +168,25 @@ def restore(
     aggregator=None,
     lifecycle=None,
     anomaly=None,
-) -> None:
+) -> Optional[int]:
     """Restore lifetime state saved by save().  Loads into the provided
     objects (merging over their current lifetime state).  With
     ``lifecycle``, the saved activity vector is remapped through the
     same by-name row mapping as the accumulator and the churn counters
     are restored; the target registry's generation is advanced to at
     least the saved one, so caches keyed on (generation, length) from a
-    pre-restore world can never serve post-restore ids."""
+    pre-restore world can never serve post-restore ids.
+
+    Returns the interval-seq watermark the snapshot was stamped with
+    (v2), or None for v1 files / unstamped saves — existing callers
+    ignore the return value, recovery replay keys on it."""
     with np.load(path, allow_pickle=False) as data:
         version = int(data["version"])
-        if version != FORMAT_VERSION:
+        if version > FORMAT_VERSION:
             raise ValueError(f"unsupported checkpoint version {version}")
+        seq_watermark = (
+            int(data["seq_watermark"]) if "seq_watermark" in data else None
+        )
 
         if metric_system is not None and "ms_counter_names" in data:
             names = _arr_names(data["ms_counter_names"])
@@ -357,6 +381,7 @@ def restore(
                     "wsum": wsum,
                     "scored_intervals": int(counters[0]),
                 })
+    return seq_watermark
 
 
 def _names_arr(names) -> np.ndarray:

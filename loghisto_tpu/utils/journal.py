@@ -29,6 +29,28 @@ logger = logging.getLogger("loghisto_tpu")
 
 FORMAT_VERSION = 1
 
+# process-wide corrupt-line ledger behind the journal.CorruptLines gauge
+_corrupt_lock = threading.Lock()
+_corrupt_lines = 0
+
+
+def corrupt_lines_total() -> int:
+    """Corrupt/torn journal lines skipped by replay() process-wide."""
+    with _corrupt_lock:
+        return _corrupt_lines
+
+
+def _note_corrupt_line() -> None:
+    global _corrupt_lines
+    with _corrupt_lock:
+        _corrupt_lines += 1
+
+
+class JournalCorruptError(Exception):
+    """A corrupt NON-final journal line under replay(strict=True) —
+    mid-file corruption means lost data that a torn final line (crash
+    mid-append) does not, so strict consumers get to refuse it."""
+
 
 class JournalVersionError(Exception):
     """The journal was written by an incompatible format version — raised
@@ -93,26 +115,53 @@ def parse_line(line: str) -> RawMetricSet:
     )
 
 
-def replay(path: str) -> Iterator[RawMetricSet]:
-    """Yield every interval in the journal; a torn/corrupt line (crash
-    mid-append) is skipped with a warning.  A format-version mismatch
-    raises JournalVersionError instead — a newer-format journal must not
-    silently replay as empty."""
+def replay(path: str, strict: bool = False) -> Iterator[RawMetricSet]:
+    """Yield every interval in the journal.  A format-version mismatch
+    raises JournalVersionError either way — a newer-format journal must
+    not silently replay as empty.
+
+    Corrupt lines split two ways by position.  A torn FINAL line is the
+    expected crash-mid-append artifact and is always skipped with a
+    warning.  Corrupt lines with valid lines after them mean real data
+    loss: with ``strict=False`` (default) they are skipped with a
+    counted warning (the ``journal.CorruptLines`` gauge); with
+    ``strict=True`` they raise JournalCorruptError instead."""
+    # a corrupt line is only provably non-final once a later non-empty
+    # line shows up, so the error is held pending until then
+    pending: Optional[tuple[int, Exception]] = None
     with open(path) as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
+            if pending is not None:
+                p_lineno, p_err = pending
+                pending = None
+                _note_corrupt_line()
+                if strict:
+                    raise JournalCorruptError(
+                        f"journal {path} line {p_lineno} corrupt mid-file"
+                        f" ({p_err})"
+                    ) from p_err
+                logger.warning(
+                    "journal %s line %d unreadable (%s); skipping",
+                    path, p_lineno, p_err,
+                )
             try:
                 yield parse_line(line)
             except JournalVersionError:
                 raise
             except (json.JSONDecodeError, AttributeError, KeyError,
                     TypeError, ValueError) as e:
-                logger.warning(
-                    "journal %s line %d unreadable (%s); skipping",
-                    path, lineno, e,
-                )
+                pending = (lineno, e)
+    if pending is not None:
+        # torn final line: tolerated in both modes (crash mid-append)
+        p_lineno, p_err = pending
+        _note_corrupt_line()
+        logger.warning(
+            "journal %s line %d unreadable (%s); skipping torn tail",
+            path, p_lineno, p_err,
+        )
 
 
 class RawJournal:
@@ -131,6 +180,8 @@ class RawJournal:
         self._capacity = channel_capacity
         self._ch: Optional[ResilientSubscription] = None
         self._thread: Optional[threading.Thread] = None
+        # chaos hook: mangles serialized lines (torn/corrupt injection)
+        self.fault_injector = None
 
     def start(self) -> None:
         """Open the file and subscribe.  Subscription happens HERE, not in
@@ -169,7 +220,11 @@ class RawJournal:
                 except ChannelClosed:
                     return
                 try:
-                    f.write(dump_line(raw) + "\n")
+                    line = dump_line(raw) + "\n"
+                    inj = self.fault_injector
+                    if inj is not None:
+                        line = inj.mangle("journal.append", line)
+                    f.write(line)
                     f.flush()
                 except OSError:
                     logger.exception("journal write failed; interval lost")

@@ -298,6 +298,11 @@ class TimeWheel:
         # swapped for a real ring by TPUMetricSystem(observability=...)
         self.obs_recorder = NULL_RECORDER
 
+        # resilience (ISSUE 10): supervised bridge + chaos hook site,
+        # installed by TPUMetricSystem(resilience=...)
+        self.supervisor = None
+        self.fault_injector = None
+
     # -- sizing --------------------------------------------------------- #
 
     def hbm_bytes(self) -> int:
@@ -372,6 +377,11 @@ class TimeWheel:
         cell arrays are built once per interval, not once per consumer;
         hooks are NOT run (the committer owns the interval tail — plain
         ``push`` runs them)."""
+        inj = self.fault_injector
+        if inj is not None:
+            # chaos hook: a scripted tier-push failure exercises the
+            # bridge's per-interval except net / supervisor restart
+            inj.check("wheel.push")
         with self.obs_recorder.span("window.tier_push", raw.seq):
             with self._lock:
                 self._note_interval_locked(raw.time, cells)
@@ -869,15 +879,26 @@ class TimeWheel:
                         "timewheel push failed for interval %s", raw.time
                     )
 
-        self._thread = threading.Thread(
-            target=bridge, daemon=True, name="loghisto-timewheel"
-        )
-        self._thread.start()
+        if self.supervisor is not None:
+            # a crashed bridge restarts with capped backoff; the clean
+            # ChannelClosed return (detach) ends the thread for good
+            self._thread = self.supervisor.spawn(
+                bridge, "loghisto-timewheel"
+            )
+        else:
+            self._thread = threading.Thread(
+                target=bridge, daemon=True, name="loghisto-timewheel"
+            )
+            self._thread.start()
 
     def detach(self) -> None:
         if self._sub is not None:
             self._sub.close()
             self._sub = None
         if self._thread is not None:
+            # stop a supervised handle's restart loop before joining
+            stop = getattr(self._thread, "stop", None)
+            if stop is not None:
+                stop()
             self._thread.join(timeout=5.0)
             self._thread = None

@@ -372,3 +372,86 @@ def test_anomaly_bank_roundtrip_remaps_by_name(tmp_path):
     )
     with pytest.raises(ValueError, match="banks"):
         am3.load_state({"prof": prof0, "wsum": wsum0})
+
+
+# -- FORMAT_VERSION 2: seq watermark (ISSUE 10 satellite) ----------------- #
+
+
+def test_v2_seq_watermark_roundtrip(tmp_path):
+    agg = TPUAggregator(num_metrics=8, config=CFG)
+    agg.record("m", 5.0)
+    agg.flush()
+    path = str(tmp_path / "wm.npz")
+    checkpoint.save(path, aggregator=agg, seq_watermark=42)
+    fresh = TPUAggregator(num_metrics=8, config=CFG)
+    assert checkpoint.restore(path, aggregator=fresh) == 42
+    assert fresh.collect().metrics["m_count"] == 1
+
+
+def test_v2_without_watermark_restores_none(tmp_path):
+    agg = TPUAggregator(num_metrics=8, config=CFG)
+    agg.record("m", 5.0)
+    path = str(tmp_path / "nowm.npz")
+    checkpoint.save(path, aggregator=agg)
+    assert checkpoint.restore(
+        path, aggregator=TPUAggregator(num_metrics=8, config=CFG)
+    ) is None
+
+
+def test_v1_checkpoint_still_restores(tmp_path):
+    # backward compatibility: a v1 snapshot (no seq_watermark key, old
+    # version stamp) loads cleanly and reports watermark None
+    import numpy as np
+
+    agg = TPUAggregator(num_metrics=8, config=CFG)
+    agg.record("m", 5.0)
+    agg.flush()
+    path = str(tmp_path / "v1.npz")
+    checkpoint.save(path, aggregator=agg)
+    data = dict(np.load(path, allow_pickle=True))
+    data["version"] = np.int64(1)
+    data.pop("seq_watermark", None)
+    np.savez(path, **data)
+
+    fresh = TPUAggregator(num_metrics=8, config=CFG)
+    assert checkpoint.restore(path, aggregator=fresh) is None
+    assert fresh.collect().metrics["m_count"] == 1
+
+
+def test_future_version_rejected(tmp_path):
+    import numpy as np
+
+    agg = TPUAggregator(num_metrics=8, config=CFG)
+    agg.record("m", 5.0)
+    path = str(tmp_path / "fut.npz")
+    checkpoint.save(path, aggregator=agg)
+    data = dict(np.load(path, allow_pickle=True))
+    data["version"] = np.int64(99)
+    np.savez(path, **data)
+    with pytest.raises(ValueError, match="version"):
+        checkpoint.restore(
+            path, aggregator=TPUAggregator(num_metrics=8, config=CFG)
+        )
+
+
+def test_injected_crash_mid_write_leaves_previous_snapshot(tmp_path):
+    from loghisto_tpu.resilience import FaultInjector, InjectedFault
+
+    agg = TPUAggregator(num_metrics=8, config=CFG)
+    agg.record("m", 5.0)
+    agg.flush()
+    path = str(tmp_path / "crash.npz")
+    checkpoint.save(path, aggregator=agg, seq_watermark=7)
+
+    agg.record("m", 9.0)
+    for site in ("checkpoint.write", "checkpoint.rename"):
+        inj = FaultInjector().plan(site, "raise")
+        with pytest.raises(InjectedFault):
+            checkpoint.save(path, aggregator=agg, seq_watermark=8,
+                            fault_injector=inj)
+        # the previous snapshot is intact and no temp litter remains
+        fresh = TPUAggregator(num_metrics=8, config=CFG)
+        assert checkpoint.restore(path, aggregator=fresh) == 7
+        assert fresh.collect().metrics["m_count"] == 1
+        leftovers = [p for p in tmp_path.iterdir() if p.suffix == ".tmp"]
+        assert not leftovers

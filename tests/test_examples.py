@@ -145,3 +145,21 @@ def test_pipeline_trace_example_runs():
     # the span ring decomposed the commit, and the Perfetto dump landed
     assert "commit.e2e" in out
     assert "perfetto:" in out and "events" in out
+
+
+def test_chaos_drill_example_runs():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "chaos_drill.py")],
+        capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    assert "health: ok (HTTP 200)" in out
+    # the injected device failures trip the breaker and /healthz says why
+    assert "breaker: open" in out
+    assert "reason: breaker_open" in out
+    # the trial dispatch recloses it
+    assert "breaker reclosed after trial dispatch; health: ok" in out
+    # the crash-scene artifacts recover into a fresh system
+    assert "recovery: watermark=" in out
+    assert "at-most-one-interval loss: OK" in out

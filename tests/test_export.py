@@ -232,3 +232,95 @@ def test_submitter_shutdown_idempotent(collector):
     sub.start()
     sub.shutdown()
     sub.shutdown()  # second shutdown is a no-op
+
+
+# -- shared retry backoff (ISSUE 10 satellite) --------------------------- #
+
+
+def _dead_addr():
+    """A port that was just closed: connects are refused immediately."""
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    addr = probe.getsockname()
+    probe.close()
+    return addr
+
+
+def test_send_with_backoff_retries_then_reports_last_error():
+    from loghisto_tpu.resilience.backoff import Backoff, send_with_backoff
+
+    bo = Backoff(base_s=0.001, cap_s=0.002, jitter=0.0)
+    err = send_with_backoff(
+        "tcp", _dead_addr(), b"x", attempts=3, backoff=bo, timeout=0.2
+    )
+    assert err is not None
+    assert bo.attempt == 2  # two naps between three attempts
+
+
+def test_send_with_backoff_success_resets_policy(collector):
+    from loghisto_tpu.resilience.backoff import Backoff, send_with_backoff
+
+    bo = Backoff(base_s=0.001, cap_s=0.002, jitter=0.0)
+    bo.next_delay()  # pretend a previous failure left it advanced
+    assert bo.current_ms > 0.0
+    err = send_with_backoff(
+        "tcp", collector.server_address, b"ok\n", attempts=3, backoff=bo
+    )
+    assert err is None
+    assert bo.current_ms == 0.0 and bo.attempt == 0
+
+
+def test_push_helpers_share_retry_policy(collector):
+    from loghisto_tpu.graphite import push_graphite
+    from loghisto_tpu.opentsdb import push_opentsdb
+    from loghisto_tpu.resilience.backoff import Backoff
+
+    assert push_graphite(
+        collector.server_address, _pms({"a": 1.0}), hostname="h"
+    ) is None
+    assert push_opentsdb(
+        collector.server_address, _pms({"a": 1.0}), hostname="h"
+    ) is None
+    dead = _dead_addr()
+    bo = Backoff(base_s=0.001, cap_s=0.002, jitter=0.0)
+    assert push_graphite(
+        dead, _pms({"a": 1.0}), hostname="h", attempts=2, backoff=bo
+    ) is not None
+    assert bo.attempt == 1  # the retry actually consulted the policy
+
+
+def test_submitter_backoff_gauges_registered():
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    sub = Submitter(
+        ms, graphite_protocol, "tcp", _dead_addr(), dial_timeout=0.2
+    )
+    sub.register_gauges()
+    raw = ms.collect_raw_metrics()
+    for g in ("export.RetryBackoffMs", "export.SendFailures",
+              "export.BacklogDepth"):
+        assert g in raw.gauges, g
+    assert raw.gauges["export.SendFailures"] == 0.0
+
+    sub._append_to_backlog(b"x\n")
+    assert sub.retry_backlog() is not None  # dead destination
+    sub._backoff.next_delay()  # what the sender loop does on failure
+    raw = ms.collect_raw_metrics()
+    assert raw.gauges["export.SendFailures"] == 1.0
+    assert raw.gauges["export.BacklogDepth"] == 1.0
+    assert raw.gauges["export.RetryBackoffMs"] > 0.0
+
+
+def test_injected_export_failure_follows_error_contract(collector):
+    from loghisto_tpu.resilience import FaultInjector
+
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    sub = Submitter(ms, graphite_protocol, "tcp", collector.server_address)
+    sub.fault_injector = FaultInjector().plan(
+        "export.send", "raise", every=1, times=2
+    )
+    assert sub.submit(b"x\n") is not None
+    assert sub.submit(b"x\n") is not None
+    assert sub.send_failures == 2
+    # plan exhausted: the real (healthy) destination takes over
+    assert sub.submit(b"x\n") is None
+    assert sub.send_failures == 2

@@ -176,3 +176,92 @@ def test_replay_skips_corrupt_gauges(tmp_path, caplog):
     with caplog.at_level("WARNING", logger="loghisto_tpu"):
         intervals = list(journal.replay(path))
     assert intervals == []
+
+
+# -- strict mode + corrupt-line ledger (ISSUE 10 satellite) --------------- #
+
+
+def test_replay_strict_raises_on_midfile_corruption(tmp_path):
+    ms, raw = _sample_raw()
+    path = str(tmp_path / "mid.jsonl")
+    with open(path, "w") as f:
+        f.write(journal.dump_line(raw) + "\n")
+        f.write("garbage not json\n")          # provably non-final
+        f.write(journal.dump_line(raw) + "\n")
+    with pytest.raises(journal.JournalCorruptError):
+        list(journal.replay(path, strict=True))
+    # lenient default still replays around it
+    assert len(list(journal.replay(path, strict=False))) == 2
+
+
+def test_replay_strict_tolerates_torn_final_line(tmp_path, caplog):
+    # a torn FINAL line is the expected crash-mid-append artifact: both
+    # modes skip it with a warning, neither raises
+    ms, raw = _sample_raw()
+    path = str(tmp_path / "tail.jsonl")
+    with open(path, "w") as f:
+        f.write(journal.dump_line(raw) + "\n")
+        f.write('{"v":1,"time":123,"counters":{"x"')
+    with caplog.at_level("WARNING", logger="loghisto_tpu"):
+        strict = list(journal.replay(path, strict=True))
+    assert len(strict) == 1
+    assert any("unreadable" in r.message for r in caplog.records)
+
+
+def test_corrupt_lines_ledger_counts_both_modes(tmp_path):
+    ms, raw = _sample_raw()
+    path = str(tmp_path / "count.jsonl")
+    with open(path, "w") as f:
+        f.write("junk\n")
+        f.write(journal.dump_line(raw) + "\n")
+        f.write('{"torn')
+    before = journal.corrupt_lines_total()
+    list(journal.replay(path))  # lenient: mid-file junk + torn tail
+    assert journal.corrupt_lines_total() == before + 2
+    before = journal.corrupt_lines_total()
+    with pytest.raises(journal.JournalCorruptError):
+        list(journal.replay(path, strict=True))
+    assert journal.corrupt_lines_total() == before + 1  # counted, then raised
+
+
+def test_journal_corrupt_lines_gauge_registered(tmp_path):
+    from loghisto_tpu.resilience import register_resilience_gauges
+
+    ms = MetricSystem(interval=1e-6, sys_stats=False)
+    register_resilience_gauges(ms)
+    raw = ms.collect_raw_metrics()
+    assert "journal.CorruptLines" in raw.gauges
+    assert raw.gauges["journal.CorruptLines"] >= 0.0
+
+
+def test_injected_torn_append_recovers_on_replay(tmp_path):
+    # chaos wiring: RawJournal.fault_injector mangles the serialized
+    # line exactly where a crash would tear it; replay survives
+    from loghisto_tpu.resilience import FaultInjector
+
+    ms = MetricSystem(interval=0.05, sys_stats=False)
+    ms.counter("c", 7)
+    path = str(tmp_path / "torn_live.jsonl")
+    j = journal.RawJournal(ms, path)
+    j.fault_injector = FaultInjector(seed=3).plan(
+        "journal.append", "truncate", on_call=2
+    )
+    ms.start()
+    j.start()
+    try:
+        deadline = time.time() + 10
+        good = []
+        while time.time() < deadline:
+            try:
+                good = list(journal.replay(path))
+            except FileNotFoundError:
+                good = []
+            if len(good) >= 2:
+                break
+            time.sleep(0.05)
+    finally:
+        j.stop()
+        ms.stop()
+    assert j.fault_injector.fires_at("journal.append") == 1
+    assert len(good) >= 2  # every line except the torn one replays
+    assert good[0].counters["c"] == 7

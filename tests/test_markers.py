@@ -56,5 +56,6 @@ def test_subsystem_markers_are_in_use():
     # legitimately have no carriers at any given time.)
     used = set(_used_markers())
     for marker in ("window", "commit", "query", "lifecycle",
-                   "ingest_transport", "anomaly", "mesh_commit", "obs"):
+                   "ingest_transport", "anomaly", "mesh_commit", "obs",
+                   "chaos"):
         assert marker in used, f"declared marker {marker!r} now unused"

@@ -1,0 +1,246 @@
+"""Unit tests for the resilience primitives (ISSUE 10): capped backoff,
+circuit breaker state machine, fault-injector plans, supervised threads.
+Pure host-side — the pipeline-level chaos drills live in test_chaos.py."""
+
+import threading
+import time
+
+import pytest
+
+from loghisto_tpu.resilience import (
+    Backoff,
+    CircuitBreaker,
+    FaultInjector,
+    InjectedFault,
+    SupervisedThread,
+    ThreadSupervisor,
+)
+
+
+# -- Backoff ------------------------------------------------------------- #
+
+
+def test_backoff_grows_and_caps():
+    bo = Backoff(base_s=0.1, cap_s=0.8, multiplier=2.0, jitter=0.0)
+    assert [bo.next_delay() for _ in range(5)] == [0.1, 0.2, 0.4, 0.8, 0.8]
+    bo.reset()
+    assert bo.next_delay() == 0.1
+
+
+def test_backoff_jitter_is_seeded_and_bounded():
+    a = Backoff(base_s=1.0, cap_s=1.0, jitter=0.25, seed=7)
+    b = Backoff(base_s=1.0, cap_s=1.0, jitter=0.25, seed=7)
+    da, db = a.next_delay(), b.next_delay()
+    assert da == db  # deterministic under a seed
+    assert 0.75 <= da <= 1.25
+
+
+def test_backoff_validates_params():
+    with pytest.raises(ValueError):
+        Backoff(base_s=0.0)
+    with pytest.raises(ValueError):
+        Backoff(base_s=2.0, cap_s=1.0)
+    with pytest.raises(ValueError):
+        Backoff(multiplier=0.5)
+
+
+# -- CircuitBreaker ------------------------------------------------------ #
+
+
+def test_breaker_opens_at_threshold_and_recloses():
+    br = CircuitBreaker(threshold=3, window_s=30.0, open_s=0.05)
+    assert br.state == "closed"
+    assert not br.record_failure()
+    assert not br.record_failure()
+    assert br.record_failure()  # third failure in the window opens it
+    assert br.state == "open" and br.opened_total == 1
+    assert br.is_open()
+    time.sleep(0.06)
+    # open_s elapsed: is_open() lets ONE trial through (half-open)
+    assert not br.is_open()
+    assert br.state == "half-open"
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_breaker_half_open_failure_reopens():
+    br = CircuitBreaker(threshold=1, window_s=30.0, open_s=0.01)
+    br.record_failure()
+    assert br.state == "open"
+    time.sleep(0.02)
+    assert not br.is_open()  # half-open trial allowed
+    assert br.record_failure()  # trial failed -> straight back open
+    assert br.state == "open" and br.opened_total == 2
+
+
+def test_breaker_window_prunes_stale_failures():
+    br = CircuitBreaker(threshold=3, window_s=0.05, open_s=1.0)
+    br.record_failure()
+    br.record_failure()
+    time.sleep(0.08)  # both age out of the window
+    assert not br.record_failure()  # only 1 failure in-window
+    assert br.state == "closed"
+    assert br.failures_total == 3  # the lifetime ledger still counts all
+
+
+# -- FaultInjector -------------------------------------------------------- #
+
+
+def test_injector_fires_on_scripted_call():
+    inj = FaultInjector()
+    inj.plan("site.a", "raise", on_call=3)
+    inj.check("site.a")
+    inj.check("site.a")
+    with pytest.raises(InjectedFault):
+        inj.check("site.a")
+    inj.check("site.a")  # times=1 exhausted: never fires again
+    assert inj.fired == [("site.a", "raise", 3)]
+    assert inj.faults_injected == 1
+
+
+def test_injector_every_with_times_budget():
+    inj = FaultInjector()
+    inj.plan("s", "raise", every=1, times=2)
+    for expect in (True, True, False, False):
+        if expect:
+            with pytest.raises(InjectedFault):
+                inj.check("s")
+        else:
+            inj.check("s")
+    assert inj.fires_at("s") == 2
+
+
+def test_injector_unknown_action_rejected():
+    with pytest.raises(ValueError):
+        FaultInjector().plan("s", "explode")
+
+
+def test_injector_disabled_site_is_noop():
+    inj = FaultInjector()
+    inj.plan("other.site", "raise")
+    inj.check("never.planned")  # no rules at this site: returns silently
+
+
+def test_injector_truncate_always_tears_the_line():
+    inj = FaultInjector(seed=5)
+    inj.plan("journal.append", "truncate")
+    line = '{"v":1,"counters":{"x":1}}\n'
+    torn = inj.mangle("journal.append", line)
+    assert torn != line and len(torn) < len(line) - 1
+    # rules exhausted: subsequent lines pass through untouched
+    assert inj.mangle("journal.append", line) == line
+
+
+def test_injector_corrupt_produces_non_json():
+    import json
+
+    inj = FaultInjector()
+    inj.plan("journal.append", "corrupt")
+    out = inj.mangle("journal.append", '{"v":1}\n')
+    with pytest.raises(json.JSONDecodeError):
+        json.loads(out)
+
+
+def test_injector_clock_step_accumulates():
+    inj = FaultInjector()
+    inj.plan("recovery.tick", "clock_step", step_s=-60.0)
+    assert inj.clock_offset() == 0.0
+    inj.check("recovery.tick")
+    assert inj.clock_offset() == -60.0
+
+
+def test_injector_wedge_releases():
+    inj = FaultInjector(wedge_timeout_s=10.0)
+    inj.plan("w", "wedge")
+    entered = threading.Event()
+
+    def worker():
+        entered.set()
+        inj.check("w")
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    entered.wait(2.0)
+    deadline = time.monotonic() + 2.0
+    while inj.wedged_now == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert inj.wedged_now == 1
+    inj.release_wedges()
+    t.join(timeout=2.0)
+    assert not t.is_alive() and inj.wedged_now == 0
+
+
+# -- SupervisedThread ----------------------------------------------------- #
+
+
+def test_supervised_thread_restarts_after_crash():
+    sup = ThreadSupervisor(base_backoff_s=0.005, max_backoff_s=0.02)
+    runs = []
+    done = threading.Event()
+
+    def target():
+        runs.append(1)
+        if len(runs) < 3:
+            raise RuntimeError("boom")
+        done.set()
+
+    t = sup.spawn(target, "flaky")
+    assert done.wait(5.0)
+    t.join(timeout=2.0)
+    assert len(runs) == 3
+    assert sup.total_restarts == 2
+    assert sup.restarts_by_name == {"flaky": 2}
+
+
+def test_supervised_thread_clean_return_never_restarts():
+    sup = ThreadSupervisor()
+    runs = []
+    t = sup.spawn(lambda: runs.append(1), "clean")
+    t.join(timeout=2.0)
+    time.sleep(0.02)
+    assert runs == [1] and sup.total_restarts == 0
+    assert not t.is_alive()
+
+
+def test_supervised_thread_stop_wakes_backoff_nap():
+    sup = ThreadSupervisor(base_backoff_s=30.0, max_backoff_s=30.0)
+
+    def always_crash():
+        raise RuntimeError("boom")
+
+    t = sup.spawn(always_crash, "crasher")
+    deadline = time.monotonic() + 2.0
+    while sup.total_restarts == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert sup.total_restarts >= 1  # it's inside a 30s backoff nap now
+    t0 = time.monotonic()
+    t.stop()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert time.monotonic() - t0 < 5.0  # stop() broke the nap
+
+
+def test_supervised_thread_is_drop_in_for_thread_handle():
+    sup = ThreadSupervisor()
+    gate = threading.Event()
+    t = sup.spawn(gate.wait, "handle")
+    assert t.is_alive() and t.daemon and t.name == "handle"
+    gate.set()
+    t.join(timeout=2.0)
+    assert not t.is_alive()
+
+
+def test_supervised_join_from_inside_target_is_safe():
+    sup = ThreadSupervisor()
+    handle = {}
+    joined = threading.Event()
+
+    def target():
+        handle["t"].join(timeout=1.0)  # joining yourself must not raise
+        joined.set()
+
+    t = SupervisedThread(target, "selfjoin", sup,
+                         Backoff(base_s=0.01, cap_s=0.01))
+    handle["t"] = t
+    t.start()
+    assert joined.wait(3.0)
