@@ -576,6 +576,30 @@ def main() -> None:
         print(f"bench: recovery stage failed: {e}", file=sys.stderr)
     ready8.set()
 
+    # federation fan-in headline (benchmarks/federation_bench.py has
+    # the 1/8/32-emitter x 1k/10k-metric grid): end-to-end samples/s
+    # from many emitter frontends through TCP framing + seq dedup +
+    # interning into the aggregator, and receiver-side wire cost per
+    # sample.
+    ready9 = _start_watchdog(300.0, on_timeout=lambda: print(
+        json.dumps(result), flush=True
+    ))
+    try:
+        from benchmarks.federation_bench import run as federation_run
+
+        fed = federation_run(
+            emitter_counts=(8,), metric_counts=(10_000,),
+            samples_per_cell=1 << 17,
+        )
+        result["federation_ingest_sps"] = fed["federation_ingest_sps"]
+        result["federation_bytes_per_sample"] = (
+            fed["federation_bytes_per_sample"]
+        )
+        result["federation_suspect"] = fed["suspect"]
+    except Exception as e:  # never let the extra metric kill the bench
+        print(f"bench: federation stage failed: {e}", file=sys.stderr)
+    ready9.set()
+
     print(json.dumps(result))
 
 

@@ -1,0 +1,184 @@
+"""Federation fan-in receipts (the ISSUE 11 tentpole): what many-emitter
+ingest through the TCP tier actually sustains, and what a sample costs
+on the wire.
+
+Grid: 1 / 8 / 32 emitters x 1k / 10k metrics.  Each emitter is a
+``FederationEmitter`` on its own thread (threads, not processes: the
+wire path — fold, frame, TCP, decode, intern, merge — is identical, and
+a 1-core CI box can't launch 32 interpreters without measuring mostly
+exec overhead).  Emitters record uniform batches over the metric space,
+fold+frame per batch, then pump their backlog through real loopback
+sockets into one ``FederationReceiver`` draining into a real
+``TPUAggregator``; the clock stops when every sample is merged AND the
+aggregator's transfer queue is drained — fan-in samples/s is
+end-to-end, not send-side.
+
+``bytes_per_sample`` is receiver-side bytes over samples: the dictionary
+delta amortizes to ~0 and each packed triple is 12 B covering however
+many samples folded into its cell, so bigger batches/fewer distinct
+cells => cheaper samples.
+
+Roofline plausibility guard: fan-in samples/s times bytes/sample is the
+implied loopback byte rate; a number above a generous loopback-bandwidth
+ceiling (20 GB/s) is physically impossible for this topology and marks
+the row suspect rather than reporting it.
+
+Usage: python benchmarks/federation_bench.py [--samples 262144]
+       [--out FEDERATION_r11.json]
+Prints one JSON object (save as FEDERATION_r*.json); importable as
+``run(...)`` for bench.py's ``federation_ingest_sps`` /
+``federation_bytes_per_sample`` headline fields.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import numpy as np
+
+BUCKET_LIMIT = 128
+BATCH = 4096
+LOOPBACK_PEAK_BYTES_PER_S = 2e10
+
+
+def _cell(n_emitters: int, n_metrics: int, total_samples: int) -> dict:
+    from loghisto_tpu.config import MetricConfig
+    from loghisto_tpu.federation.emitter import FederationEmitter
+    from loghisto_tpu.federation.receiver import FederationReceiver
+    from loghisto_tpu.parallel.aggregator import TPUAggregator
+
+    cfg = MetricConfig(bucket_limit=BUCKET_LIMIT)
+    agg = TPUAggregator(num_metrics=n_metrics + 16, config=cfg)
+    rx = FederationReceiver(agg, recv_bytes=1 << 18)
+    rx.start()
+
+    batches_per_emitter = max(1, total_samples // (n_emitters * BATCH))
+    per_emitter = batches_per_emitter * BATCH
+    total = per_emitter * n_emitters
+
+    def emit(idx: int, out: dict) -> None:
+        e = FederationEmitter(
+            ("127.0.0.1", rx.port), interval=3600.0, config=cfg,
+            emitter_id=idx + 1,
+            backlog_slots=batches_per_emitter + 8,
+        )
+        rng = np.random.default_rng(idx)
+        # register the full name space up front (steady state: the
+        # dictionary delta rides the first frame, then ~0 bytes)
+        lids = np.array(
+            [e.local_id(f"m{j}") for j in range(n_metrics)],
+            dtype=np.int32,
+        )
+        for _ in range(batches_per_emitter):
+            ids = lids[rng.integers(0, n_metrics, BATCH)]
+            values = rng.lognormal(3.0, 2.0, BATCH).astype(np.float32)
+            e.record_batch(ids, values)
+            e.flush(heartbeat=False)  # one frame per batch
+        ok = e.drain(timeout=600.0)  # pump the backlog through TCP
+        out[idx] = (ok, e.samples_shipped, e.bytes_sent)
+
+    results: dict = {}
+    threads = [
+        threading.Thread(target=emit, args=(i, results))
+        for i in range(n_emitters)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    deadline = time.monotonic() + 600.0
+    while rx.samples_merged < total and time.monotonic() < deadline:
+        time.sleep(0.005)
+    agg.wait_transfers()
+    wall_s = time.perf_counter() - t0
+    rx.stop()
+
+    assert all(ok for ok, _, _ in results.values()), "emitter drain failed"
+    assert rx.samples_merged == total, (rx.samples_merged, total)
+    bytes_per_sample = rx.bytes_received / total
+    sps = total / wall_s
+    suspect = sps * bytes_per_sample > LOOPBACK_PEAK_BYTES_PER_S
+    agg.close()
+    return {
+        "emitters": n_emitters,
+        "metrics": n_metrics,
+        "samples": total,
+        "frames": rx.frames_received,
+        "wall_s": round(wall_s, 3),
+        "fanin_samples_per_s": round(sps, 1),
+        "bytes_per_sample": round(bytes_per_sample, 3),
+        "decode_errors": rx.decode_errors,
+        "suspect": suspect,
+    }
+
+
+def run(
+    emitter_counts=(1, 8, 32),
+    metric_counts=(1_000, 10_000),
+    samples_per_cell: int = 1 << 18,
+) -> dict:
+    grid = []
+    for m in metric_counts:
+        for e in emitter_counts:
+            cell = _cell(e, m, samples_per_cell)
+            grid.append(cell)
+            print(
+                f"federation_bench: {e:>2} emitters x {m:>6} metrics: "
+                f"{cell['fanin_samples_per_s']:>12.0f} samples/s, "
+                f"{cell['bytes_per_sample']:.2f} B/sample"
+                + (" [SUSPECT]" if cell["suspect"] else ""),
+                file=sys.stderr,
+            )
+    # the headline cell: the fleet shape the demo ships (8 emitters)
+    # at the repo's standard 10k-metric working point
+    head = next(
+        (c for c in grid if c["emitters"] == 8 and c["metrics"] == 10_000),
+        grid[-1],
+    )
+    return {
+        "bench": "federation_fanin",
+        "batch": BATCH,
+        "bucket_limit": BUCKET_LIMIT,
+        "grid": grid,
+        "federation_ingest_sps": (
+            None if head["suspect"] else head["fanin_samples_per_s"]
+        ),
+        "federation_bytes_per_sample": head["bytes_per_sample"],
+        "suspect": head["suspect"],
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--samples", type=int, default=1 << 18,
+                        help="samples per grid cell")
+    parser.add_argument("--tpu", action="store_true",
+                        help="keep the configured (TPU) platform instead "
+                             "of forcing CPU")
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args(argv)
+
+    import jax
+
+    if not args.tpu:
+        jax.config.update("jax_platforms", "cpu")
+    result = run(samples_per_cell=args.samples)
+    text = json.dumps(result, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

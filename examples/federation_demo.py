@@ -1,0 +1,158 @@
+"""Federation tier, end to end: 8 emitter processes, one aggregator pod.
+
+The deployment shape the federation tier exists for: many frontend
+processes (workers, sidecars, request handlers) each run a jax-free
+``FederationEmitter`` that folds its samples to packed int32 triples
+once per interval and ships them as CRC-framed deltas over TCP; ONE
+``TPUMetricSystem(federation=...)`` pod interns the names, deduplicates
+frames by per-emitter sequence number, and merges every delta through
+the same device scatter-add local samples take — so fleet-wide
+percentiles come off the accelerator as if one process had recorded
+everything.
+
+Three acts:
+
+  1. fan-in — 8 emitter subprocesses (this script re-execs itself with
+     ``--emitter``) record deterministic latency samples and ship them;
+     the pod's live ``device_metrics()`` percentiles are queried while
+     frames are still arriving.
+  2. churn  — half the emitters drain and exit (a deploy rolling the
+     fleet); replacement processes with FRESH emitter ids pick up the
+     traffic.  Queries keep serving throughout; the receiver's
+     per-emitter lag gauges show the handoff.
+  3. audit  — every emitter printed how many samples it shipped; the
+     pod's merged totals and device-side counts must match the sum
+     exactly (the conservation contract: TCP + framing + dedup +
+     interning lose and double-count nothing).
+
+Runs anywhere (CPU backend); the emitter processes never import jax.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SAMPLES_PER_EMITTER = 2000
+BATCH = 250
+
+
+def run_emitter(idx: int, port: int) -> int:
+    """One emitter process: record, flush, drain, report, exit."""
+    import numpy as np
+
+    from loghisto_tpu.federation.emitter import FederationEmitter
+
+    e = FederationEmitter(
+        ("127.0.0.1", port), interval=0.25, emitter_id=5000 + idx,
+    )
+    e.start()
+    rng = np.random.default_rng(idx)
+    lat = e.local_id("frontend.request.lat_us")
+    size = e.local_id("frontend.response.bytes")
+    for _ in range(SAMPLES_PER_EMITTER // BATCH):
+        e.record_batch(
+            np.full(BATCH, lat, dtype=np.int32),
+            (rng.lognormal(mean=6.0, sigma=1.0, size=BATCH)
+             .astype(np.float32)),
+        )
+        e.record_batch(
+            np.full(BATCH, size, dtype=np.int32),
+            rng.uniform(100, 1e6, size=BATCH).astype(np.float32),
+        )
+        time.sleep(0.02)  # a trickle, so frames span several intervals
+    ok = e.close(drain_timeout=30.0)
+    assert "jax" not in sys.modules, "emitter imported jax"
+    print(f"EMITTER {idx} shipped {e.samples_shipped} samples "
+          f"in {e.frames_shipped} frames", flush=True)
+    return 0 if ok else 1
+
+
+def spawn(idx: int, port: int):
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--emitter", str(idx), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from loghisto_tpu.federation import FederationConfig
+    from loghisto_tpu.system import TPUMetricSystem
+
+    ms = TPUMetricSystem(
+        interval=1.0, sys_stats=False, num_metrics=256,
+        federation=FederationConfig(expected_emitters=8),
+        retention=True, observability=True,
+    )
+    ms.start()
+    fed = ms.federation
+    print(f"aggregator pod listening on 127.0.0.1:{fed.port}")
+
+    # act 1: fan-in — first wave of emitters
+    procs = {i: spawn(i, fed.port) for i in range(8)}
+    print("8 emitter processes launched")
+    while fed.samples_merged < 8 * SAMPLES_PER_EMITTER // 4:
+        time.sleep(0.1)
+    pms = ms.device_metrics(reset=False)
+    p99 = pms.metrics.get("frontend.request.lat_us_99", 0.0)
+    print(f"live query mid-stream: lat p99 = {p99:.1f} us over "
+          f"{int(pms.metrics.get('frontend.request.lat_us_count', 0))} "
+          "samples (frames still arriving)")
+
+    # act 2: churn — roll half the fleet while queries keep serving
+    for i in range(4):
+        procs[i].wait(timeout=120)
+    print("4 emitters exited (rolling deploy); "
+          f"{len(fed.emitters)} emitter ids seen so far")
+    for i in range(4):
+        procs[8 + i] = spawn(8 + i, fed.port)
+    print("4 replacement emitters launched")
+    pms = ms.device_metrics(reset=False)
+    print("live query during churn: lat p99 = "
+          f"{pms.metrics.get('frontend.request.lat_us_99', 0.0):.1f} us")
+
+    # act 3: audit — exact conservation across the whole fleet
+    shipped_total = 0
+    for i, p in procs.items():
+        out, _ = p.communicate(timeout=120)
+        if p.returncode != 0:
+            print(out)
+            return 1
+        shipped_total += int(out.split(" shipped ")[1].split()[0])
+    deadline = time.monotonic() + 60
+    while fed.samples_merged < shipped_total:
+        if time.monotonic() > deadline:
+            break
+        time.sleep(0.05)
+    ms.aggregator.wait_transfers()
+    pms = ms.device_metrics(reset=False)
+    dev_count = int(
+        pms.metrics["frontend.request.lat_us_count"]
+        + pms.metrics["frontend.response.bytes_count"]
+    )
+    st = fed.stats()
+    print(f"emitters shipped {shipped_total} samples total; pod merged "
+          f"{st['samples_merged']} ({st['frames_received']} frames, "
+          f"{st['duplicate_frames']} duplicates deduped, "
+          f"{st['decode_errors']} decode errors)")
+    print(f"device-side count: {dev_count}")
+    assert st["samples_merged"] == shipped_total == dev_count
+    print(f"conservation exact across {len(st['emitters'])} emitter "
+          "processes: OK")
+    report = ms.health.report()
+    print(f"health: {report.status}")
+    ms.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--emitter":
+        sys.exit(run_emitter(int(sys.argv[2]), int(sys.argv[3])))
+    sys.exit(main())

@@ -17,9 +17,18 @@ from loghisto_tpu.metrics import (
     TimerToken,
     merge_raw_metric_sets,
 )
-from loghisto_tpu.system import TPUMetricSystem
-
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Lazy (PEP 562): TPUMetricSystem pulls jax; federation emitter
+    # processes import this package jax-free on the host-tier names
+    # above.  Everything else about the public surface is unchanged.
+    if name == "TPUMetricSystem":
+        from loghisto_tpu.system import TPUMetricSystem
+
+        return TPUMetricSystem
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 # Package-level default system, mirroring the reference's
 # `var Metrics = NewMetricSystem(60*time.Second, true)` (metrics.go:137-139).

@@ -1,0 +1,74 @@
+"""Federation tier: many emitter processes, one aggregator pod (ISSUE 11).
+
+``FederationEmitter`` runs inside any frontend process — it is jax-free
+by construction (this package imports it lazily, and everything on its
+dependency path stays off jax) — folds locally recorded samples into
+packed ``[n, 3]`` int32 (id, codec_bucket, count) triples once per
+interval, frames them (versioned header + name-dictionary delta + CRC32,
+ops/codec.py), and ships them over TCP through the shared
+``submitter.BacklogSender`` retry machinery.
+
+``FederationReceiver`` runs next to the ``TPUAggregator``: supervised
+accept/decode threads, per-emitter sequence tracking with gap detection
+and idempotent re-delivery, name→row interning through the registry
+free-list, and the decoded triples drain into the aggregator's packed
+ingest path so federated deltas merge through the same fused commit.
+int32 scatter-adds are order-independent, so the aggregate is
+bit-identical to a single-process oracle regardless of arrival order.
+
+Wired into the system as ``TPUMetricSystem(federation=
+FederationConfig(...))``; chaos hook sites ``fed.accept`` /
+``fed.decode`` / ``fed.send``; ``federation.*`` gauges; the
+``emitter_starvation`` and ``fed_decode_errors`` health invariants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class FederationConfig:
+    """Receiver-side federation knobs for TPUMetricSystem.
+
+    Attributes:
+      host/port: TCP listen address; port 0 binds an ephemeral port
+        (read it back from ``ms.federation.port`` after ``start()``).
+      expected_emitters: how many distinct emitters SHOULD be feeding
+        this pod.  Zero means "whatever shows up"; nonzero arms the
+        ``emitter_starvation`` health invariant before the first frame
+        ever arrives, so a pod that never hears from its fleet pages.
+      journal_path: append every applied frame to a binary frame journal
+        (utils/journal.FrameJournal) for receiver-restart replay.
+      replay_on_start: re-apply the journal into the (fresh) aggregator
+        when the receiver starts — bit-identical restart recovery.
+        Leave False when the aggregator state is restored by checkpoint
+        recovery instead (replaying on top would double count).
+      starvation_intervals: how many system intervals of frame silence
+        before ``emitter_starvation`` trips.
+    """
+
+    host: str = "127.0.0.1"
+    port: int = 0
+    expected_emitters: int = 0
+    journal_path: Optional[str] = None
+    replay_on_start: bool = False
+    starvation_intervals: float = 3.0
+
+
+def __getattr__(name):
+    # Lazy (PEP 562): the emitter must import without jax; the receiver
+    # pulls numpy-heavy machinery the config-only import path can skip.
+    if name == "FederationEmitter":
+        from loghisto_tpu.federation.emitter import FederationEmitter
+
+        return FederationEmitter
+    if name == "FederationReceiver":
+        from loghisto_tpu.federation.receiver import FederationReceiver
+
+        return FederationReceiver
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = ["FederationConfig", "FederationEmitter", "FederationReceiver"]

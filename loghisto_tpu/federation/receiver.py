@@ -1,0 +1,636 @@
+"""FederationReceiver: the aggregator-pod half of the federation tier.
+
+A TCP listener whose accept thread (and each connection's decode thread)
+runs under the resilience supervisor when one is attached — a crashed
+loop restarts with capped-exponential backoff and shows on the
+``thread_restarted`` health invariant.  Per connection: buffered recv,
+greedy frame parse (ops/codec.py), DELTA payload decode
+(federation/wire.py), then apply:
+
+  * sequence tracking per emitter_id — a seq applied before (or fallen
+    behind the reorder window) is counted and dropped (idempotent
+    re-delivery: the at-least-once sender can repeat frames freely).
+    Each frame rides its own TCP connection, so connection threads can
+    legally apply frames out of order; a never-seen seq inside the
+    window still applies and un-counts its provisional gap.  Seqs still
+    missing count ``seq_gaps`` (frames that died in an emitter's
+    wrapped backlog or crash).
+  * name interning — dictionary deltas map emitter-local ids to
+    aggregator registry rows through ``TPUAggregator._id_for`` (the
+    free-list reuse / grow-then-shed policy every other ingest path
+    gets); the triple id column is rewritten vectorized.  Rows whose
+    local id has no mapping yet PARK (bounded) while the emitter has
+    open seq gaps — the dictionary frame may merely be late — and merge
+    when it lands; they shed only when every gap is filled and the name
+    still never arrived, on age-out/overflow, or at stop().
+  * merge — rewritten triples drain into the aggregator's packed ingest
+    (``merge_packed``), i.e. the PR-6 staging/transfer pipeline and the
+    same fused commit as local samples.  int32 scatter-adds are
+    order-independent: the aggregate is bit-identical to a
+    single-process oracle fed the same samples in any order.
+
+Corruption never merges: a frame that fails CRC or schema validation
+counts ``decode_errors`` and drops the CONNECTION (the stream offers no
+resync point), exactly like an emitter crash mid-frame — whose torn
+partial frame is likewise counted and discarded at EOF.
+
+With ``journal_path`` every applied frame is write-ahead appended to a
+binary ``FrameJournal`` (same frame codec as the wire); after a
+receiver restart with a fresh aggregator, ``replay_journal()`` rebuilds
+bit-identical state — duplicates in the journal deduplicate through the
+same seq tracking as live frames.
+
+Chaos hook sites: ``fed.accept`` (accept loop, per connection) and
+``fed.decode`` (per frame, before apply); the emitter side holds
+``fed.send``.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from loghisto_tpu.federation import wire
+from loghisto_tpu.ops.codec import (
+    FrameError, FrameTruncated, decode_frame,
+)
+
+_ACCEPT_POLL_S = 0.25
+# Reorder window: a never-before-seen seq no further than this behind
+# the high-water mark still applies (one connection per frame means
+# frames from one emitter can race each other through conn threads);
+# anything older is indistinguishable from a stale re-delivery and is
+# dropped as a duplicate.
+SEQ_WINDOW = 4096
+# row_map sentinels: a local id whose dictionary entry never arrived
+# (may be in a late frame) vs. one whose name the registry shed
+ROW_UNKNOWN = -2
+ROW_SHED = -1
+# parked-row bounds per emitter: rows waiting on a late dictionary
+# frame shed once this many rows queue up or once the emitter's seq
+# high-water mark has advanced this far past their arrival
+MAX_PARKED_ROWS = 1 << 16
+PARK_SEQ_AGE = 64
+
+
+class _NullSpan:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _NullRecorder:
+    def span(self, *_a, **_k):
+        return _NullSpan()
+
+
+_NULL_RECORDER = _NullRecorder()
+
+
+class _EmitterState:
+    """Per-emitter sequencing + id-mapping state, keyed by emitter_id."""
+
+    __slots__ = (
+        "last_seq", "seen", "row_map", "parked", "parked_rows",
+        "last_frame_t", "frames", "samples", "duplicates", "gaps",
+    )
+
+    def __init__(self):
+        self.last_seq = 0          # high-water mark
+        self.seen: set[int] = set()  # applied seqs within SEQ_WINDOW
+        # emitter-local id -> aggregator row (ROW_UNKNOWN: dictionary
+        # entry not seen yet; ROW_SHED: the registry shed the name)
+        self.row_map = np.full(64, ROW_UNKNOWN, dtype=np.int32)
+        # rows waiting on a late dictionary frame: (hwm_at_park, packed)
+        self.parked: list = []
+        self.parked_rows = 0
+        self.last_frame_t = time.monotonic()
+        self.frames = 0
+        self.samples = 0
+        self.duplicates = 0
+        self.gaps = 0
+
+
+class FederationReceiver:
+    def __init__(
+        self,
+        aggregator,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        journal_path: Optional[str] = None,
+        replay_on_start: bool = False,
+        expected_emitters: int = 0,
+        supervisor=None,
+        fault_injector=None,
+        obs_recorder=None,
+        recv_bytes: int = 1 << 16,
+    ):
+        self.aggregator = aggregator
+        self.host = host
+        self.port = int(port)  # rewritten to the bound port on start()
+        self.journal_path = journal_path
+        self.replay_on_start = replay_on_start
+        self.expected_emitters = int(expected_emitters)
+        self.supervisor = supervisor
+        self.fault_injector = fault_injector
+        self.obs_recorder = obs_recorder or _NULL_RECORDER
+        self.recv_bytes = recv_bytes
+
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread = None
+        self._conn_threads: list = []
+        self._stop = threading.Event()
+        self._lock = threading.Lock()       # guards apply + counters
+        self._journal = None
+        self._started_t: Optional[float] = None
+
+        self.emitters: dict[int, _EmitterState] = {}
+        self.frames_received = 0
+        self.bytes_received = 0
+        self.decode_errors = 0
+        self.duplicate_frames = 0
+        self.seq_gaps = 0
+        self.samples_merged = 0
+        self.samples_shed = 0    # rows whose name never resolved
+        self.samples_parked = 0  # rows currently waiting on a late dict
+        self.frames_replayed = 0
+        self.connections_total = 0
+        self.connections_active = 0
+        # frames/s gauge state: (monotonic t, frames_received) at last read
+        self._rate_mark = (time.monotonic(), 0)
+
+    # -- lifecycle ------------------------------------------------------ #
+
+    def start(self) -> None:
+        """Replay the journal if configured, bind, and start accepting.
+        ``self.port`` holds the real bound port afterwards (port=0 asks
+        the OS for an ephemeral one)."""
+        if self._sock is not None:
+            return
+        if self.replay_on_start and self.journal_path is not None:
+            import os
+
+            if os.path.exists(self.journal_path):
+                self.replay_journal()
+        if self.journal_path is not None:
+            from loghisto_tpu.utils.journal import FrameJournal
+
+            self._journal = FrameJournal(self.journal_path)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self.host, self.port))
+        sock.listen(128)
+        sock.settimeout(_ACCEPT_POLL_S)  # poll so stop() can interrupt
+        self.port = sock.getsockname()[1]
+        self._sock = sock
+        self._stop.clear()
+        self._started_t = time.monotonic()
+        self._accept_thread = self._spawn(
+            self._accept_loop, "loghisto-fed-accept"
+        )
+
+    def _spawn(self, target, name: str):
+        if self.supervisor is not None:
+            return self.supervisor.spawn(target, name)
+        t = threading.Thread(target=target, daemon=True, name=name)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        """Stop accepting, close every connection's thread, close the
+        journal.  In-flight decoded frames finish applying; the
+        aggregator's transfer queue keeps whatever was already merged."""
+        self._stop.set()
+        t = self._accept_thread
+        if t is not None:
+            if hasattr(t, "stop"):
+                t.stop()  # SupervisedThread: no restart after this
+            self._accept_thread = None
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if t is not None:
+            t.join(timeout=5.0)
+        for ct in self._conn_threads:
+            if hasattr(ct, "stop"):
+                ct.stop()
+            ct.join(timeout=5.0)
+        self._conn_threads = []
+        with self._lock:
+            # finalize the ledger: rows still waiting on a dictionary
+            # frame at shutdown will never resolve — count them shed
+            for state in self.emitters.values():
+                for _hwm, upack in state.parked:
+                    samples = int(upack[:, 2].sum(dtype=np.int64))
+                    self.samples_shed += samples
+                    self.samples_parked -= samples
+                state.parked = []
+                state.parked_rows = 0
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+
+    # -- accept / decode ------------------------------------------------ #
+
+    def _accept_loop(self) -> None:
+        sock = self._sock
+        while not self._stop.is_set() and sock is not None:
+            try:
+                conn, _addr = sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed by stop()
+            inj = self.fault_injector
+            if inj is not None:
+                # a scripted raise here crashes the (supervised) accept
+                # thread AFTER the 3-way handshake — the client sees the
+                # connection reset, the supervisor restarts the loop
+                try:
+                    inj.check("fed.accept")
+                except Exception:
+                    conn.close()
+                    raise
+            self.connections_total += 1
+            self._conn_threads = [
+                ct for ct in self._conn_threads if ct.is_alive()
+            ]
+            self._conn_threads.append(self._spawn(
+                lambda c=conn: self._conn_loop(c),
+                f"loghisto-fed-conn-{self.connections_total}",
+            ))
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        self.connections_active += 1
+        buf = bytearray()
+        try:
+            conn.settimeout(_ACCEPT_POLL_S)
+            while not self._stop.is_set():
+                try:
+                    chunk = conn.recv(self.recv_bytes)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                if not chunk:
+                    break  # peer closed
+                self.bytes_received += len(chunk)
+                buf += chunk
+                if not self._drain_buffer(buf):
+                    return  # corrupt frame: drop the connection
+            # EOF with a partial frame = emitter crashed (or was killed)
+            # mid-frame: count it, merge nothing from it
+            if len(buf):
+                with self._lock:
+                    self.decode_errors += 1
+        finally:
+            self.connections_active -= 1
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _drain_buffer(self, buf: bytearray) -> bool:
+        """Greedily decode+apply every complete frame in ``buf``,
+        consuming the decoded prefix.  False means the stream is corrupt
+        and the caller must drop the connection."""
+        offset = 0
+        try:
+            while True:
+                try:
+                    kind, payload, offset = decode_frame(buf, offset)
+                except FrameTruncated:
+                    break  # need more bytes
+                self._handle_frame(kind, payload)
+        except (FrameError, wire.WireError):
+            with self._lock:
+                self.decode_errors += 1
+            return False
+        finally:
+            if offset:
+                del buf[:offset]
+        return True
+
+    def _handle_frame(self, kind: int, payload: bytes) -> None:
+        inj = self.fault_injector
+        if inj is not None:
+            # scripted decode failure: follows the organic-corruption
+            # path (counted, connection dropped), not a thread crash
+            try:
+                inj.check("fed.decode")
+            except Exception as e:
+                raise wire.WireError(f"injected decode fault: {e}") from e
+        if kind != wire.KIND_DELTA:
+            raise wire.WireError(f"unknown frame kind {kind}")
+        with self.obs_recorder.span("fed.apply"):
+            delta = wire.decode_delta(payload)
+            if self._journal is not None:
+                # write-ahead, before apply: replay after a crash
+                # re-applies through the same seq dedup, so the journal
+                # being ahead of the aggregator is safe; behind is not
+                self._journal.append(kind, payload)
+            self._apply_delta(delta)
+
+    # -- apply ---------------------------------------------------------- #
+
+    def _apply_delta(self, delta: wire.DeltaFrame) -> None:
+        agg = self.aggregator
+        with self._lock:
+            state = self.emitters.get(delta.emitter_id)
+            if state is None:
+                state = self.emitters[delta.emitter_id] = _EmitterState()
+                self._register_emitter_gauge(delta.emitter_id)
+            # dictionary deltas apply even on duplicate frames —
+            # interning is idempotent and a re-delivered frame may be
+            # the only carrier of a name whose first copy half-applied
+            for local_id, name in delta.names:
+                if local_id >= len(state.row_map):
+                    grown = np.full(
+                        max(2 * len(state.row_map), local_id + 1),
+                        ROW_UNKNOWN, dtype=np.int32,
+                    )
+                    grown[:len(state.row_map)] = state.row_map
+                    state.row_map = grown
+                state.row_map[local_id] = agg._id_for(name)
+            state.last_frame_t = time.monotonic()
+            seq = delta.seq
+            merges: list = []
+            if seq in state.seen or seq <= state.last_seq - SEQ_WINDOW:
+                state.duplicates += 1
+                self.duplicate_frames += 1
+            else:
+                if seq > state.last_seq:
+                    missed = seq - state.last_seq - 1
+                    if missed:
+                        # provisional: a frame applying late un-counts
+                        # itself below
+                        state.gaps += missed
+                        self.seq_gaps += missed
+                    state.last_seq = seq
+                else:
+                    # in-window reorder: this seq was counted as a gap
+                    # when a higher seq overtook it — it arrived after
+                    # all
+                    state.gaps -= 1
+                    self.seq_gaps -= 1
+                state.seen.add(seq)
+                if len(state.seen) > 2 * SEQ_WINDOW:
+                    floor = state.last_seq - SEQ_WINDOW
+                    state.seen = {s for s in state.seen if s > floor}
+                self.frames_received += 1
+                state.frames += 1
+                if len(delta.packed):
+                    self._map_rows_locked(state, delta.packed, merges)
+            # a frame (even a duplicate) may have carried the dictionary
+            # entries parked rows were waiting on
+            if state.parked:
+                self._resolve_parked_locked(state, merges)
+        for packed in merges:
+            agg.merge_packed(packed)
+
+    def _map_rows_locked(self, state: _EmitterState, packed, merges) -> None:
+        """Rewrite the local-id column through ``row_map``; merge the
+        mapped rows, shed registry-shed rows, park unknown ones while a
+        seq gap leaves room for their dictionary frame to still arrive.
+        Caller holds ``self._lock``."""
+        local = packed[:, 0]
+        n = len(state.row_map)
+        mapped = np.where(
+            (local >= 0) & (local < n),
+            state.row_map[np.clip(local, 0, n - 1)], ROW_UNKNOWN,
+        )
+        shed = mapped == ROW_SHED
+        if shed.any():
+            self.samples_shed += int(packed[shed, 2].sum(dtype=np.int64))
+        unknown = mapped == ROW_UNKNOWN
+        if unknown.any():
+            upack = packed[unknown]
+            usamples = int(upack[:, 2].sum(dtype=np.int64))
+            if (state.gaps > 0
+                    and state.parked_rows + len(upack) <= MAX_PARKED_ROWS):
+                state.parked.append((state.last_seq, upack))
+                state.parked_rows += len(upack)
+                self.samples_parked += usamples
+            else:
+                # no open gap can explain the missing dictionary entry
+                # (or the park bound is hit): the name never arrived
+                self.samples_shed += usamples
+        keep = mapped >= 0
+        if keep.any():
+            out = packed[keep]
+            out[:, 0] = mapped[keep]
+            samples = int(out[:, 2].sum(dtype=np.int64))
+            state.samples += samples
+            self.samples_merged += samples
+            merges.append(out)
+
+    def _resolve_parked_locked(self, state: _EmitterState, merges) -> None:
+        """Retry parked rows against the (possibly just-extended)
+        row_map: resolved rows merge, registry-shed rows shed, rows
+        still unknown stay parked while a gap remains open and they have
+        not aged out.  Caller holds ``self._lock``."""
+        still: list = []
+        for hwm, upack in state.parked:
+            local = upack[:, 0]
+            n = len(state.row_map)
+            mapped = np.where(
+                (local >= 0) & (local < n),
+                state.row_map[np.clip(local, 0, n - 1)], ROW_UNKNOWN,
+            )
+            resolved = mapped >= 0
+            if resolved.any():
+                out = upack[resolved]
+                out[:, 0] = mapped[resolved]
+                samples = int(out[:, 2].sum(dtype=np.int64))
+                state.samples += samples
+                self.samples_merged += samples
+                self.samples_parked -= samples
+                merges.append(out)
+            regshed = mapped == ROW_SHED
+            if regshed.any():
+                samples = int(upack[regshed, 2].sum(dtype=np.int64))
+                self.samples_shed += samples
+                self.samples_parked -= samples
+            unknown = mapped == ROW_UNKNOWN
+            if unknown.any():
+                rest = upack[unknown]
+                samples = int(rest[:, 2].sum(dtype=np.int64))
+                if (state.gaps > 0
+                        and state.last_seq - hwm <= PARK_SEQ_AGE):
+                    still.append((hwm, rest))
+                else:
+                    self.samples_shed += samples
+                    self.samples_parked -= samples
+        state.parked = still
+        state.parked_rows = sum(len(p) for _, p in still)
+
+    # -- journal replay -------------------------------------------------- #
+
+    def replay_journal(self, path: Optional[str] = None) -> int:
+        """Re-apply every journaled frame through the normal apply path
+        (duplicates deduplicate by seq exactly like live re-delivery).
+        Returns the number of frames applied.  Only meaningful against
+        an aggregator that does NOT already contain these samples — the
+        receiver-restart-with-fresh-state recovery drill."""
+        from loghisto_tpu.utils.journal import FrameJournal
+
+        path = path if path is not None else self.journal_path
+        if path is None:
+            raise ValueError("no journal_path configured or given")
+        n = 0
+        for kind, payload in FrameJournal.replay(path):
+            if kind != wire.KIND_DELTA:
+                continue
+            try:
+                self._apply_delta(wire.decode_delta(payload))
+            except wire.WireError:
+                with self._lock:
+                    self.decode_errors += 1
+                continue
+            n += 1
+        self.frames_replayed += n
+        return n
+
+    # -- health / gauges ------------------------------------------------- #
+
+    def max_emitter_lag_s(self) -> float:
+        """Age of the STALEST emitter's last frame (0 with no emitters):
+        the fleet-wide freshness bound the lag gauge and the starvation
+        invariant read."""
+        now = time.monotonic()
+        with self._lock:
+            if not self.emitters:
+                return 0.0
+            return max(
+                now - s.last_frame_t for s in self.emitters.values()
+            )
+
+    def last_frame_age_s(self) -> float:
+        """Seconds since ANY frame arrived (since start() before the
+        first frame; 0 when never started)."""
+        now = time.monotonic()
+        with self._lock:
+            if self.emitters:
+                return min(
+                    now - s.last_frame_t for s in self.emitters.values()
+                )
+        if self._started_t is None:
+            return 0.0
+        return now - self._started_t
+
+    def frames_per_s(self) -> float:
+        """Frame arrival rate since the last call (gauge-scrape shaped)."""
+        now = time.monotonic()
+        t0, f0 = self._rate_mark
+        frames = self.frames_received
+        self._rate_mark = (now, frames)
+        dt = now - t0
+        if dt <= 0.0:
+            return 0.0
+        return (frames - f0) / dt
+
+    def stats(self) -> dict:
+        with self._lock:
+            per_emitter = {
+                f"{eid:016x}": {
+                    "last_seq": s.last_seq,
+                    "frames": s.frames,
+                    "samples": s.samples,
+                    "duplicates": s.duplicates,
+                    "gaps": s.gaps,
+                    "lag_s": round(
+                        time.monotonic() - s.last_frame_t, 3
+                    ),
+                }
+                for eid, s in self.emitters.items()
+            }
+        return {
+            "port": self.port,
+            "connections_active": self.connections_active,
+            "connections_total": self.connections_total,
+            "frames_received": self.frames_received,
+            "frames_replayed": self.frames_replayed,
+            "bytes_received": self.bytes_received,
+            "decode_errors": self.decode_errors,
+            "duplicate_frames": self.duplicate_frames,
+            "seq_gaps": self.seq_gaps,
+            "samples_merged": self.samples_merged,
+            "samples_shed": self.samples_shed,
+            "samples_parked": self.samples_parked,
+            "emitters": per_emitter,
+        }
+
+    def register_gauges(self, ms) -> None:
+        """The ``federation.*`` gauge family on the ordinary exporter
+        pipeline; per-emitter lag gauges register lazily as emitters
+        first appear."""
+        self._ms = ms
+        ms.register_gauge_func(
+            "federation.ConnectedEmitters",
+            lambda: float(len(self.emitters)),
+        )
+        ms.register_gauge_func(
+            "federation.ActiveConnections",
+            lambda: float(self.connections_active),
+        )
+        ms.register_gauge_func(
+            "federation.FramesReceived",
+            lambda: float(self.frames_received),
+        )
+        ms.register_gauge_func(
+            "federation.FramesPerSec", self.frames_per_s,
+        )
+        ms.register_gauge_func(
+            "federation.BytesReceived",
+            lambda: float(self.bytes_received),
+        )
+        ms.register_gauge_func(
+            "federation.DecodeErrors",
+            lambda: float(self.decode_errors),
+        )
+        ms.register_gauge_func(
+            "federation.DuplicateFrames",
+            lambda: float(self.duplicate_frames),
+        )
+        ms.register_gauge_func(
+            "federation.SeqGaps", lambda: float(self.seq_gaps),
+        )
+        ms.register_gauge_func(
+            "federation.SamplesMerged",
+            lambda: float(self.samples_merged),
+        )
+        ms.register_gauge_func(
+            "federation.SamplesShed",
+            lambda: float(self.samples_shed),
+        )
+        ms.register_gauge_func(
+            "federation.SamplesParked",
+            lambda: float(self.samples_parked),
+        )
+        ms.register_gauge_func(
+            "federation.MaxEmitterLagS", self.max_emitter_lag_s,
+        )
+
+    def _register_emitter_gauge(self, emitter_id: int) -> None:
+        ms = getattr(self, "_ms", None)
+        if ms is None:
+            return
+        def _lag(eid=emitter_id) -> float:
+            with self._lock:
+                s = self.emitters.get(eid)
+                if s is None:
+                    return 0.0
+                return time.monotonic() - s.last_frame_t
+        ms.register_gauge_func(
+            f"federation.emitter.{emitter_id:016x}.LagS", _lag
+        )

@@ -1,0 +1,124 @@
+"""Federation payload schema: the DELTA frame.
+
+One frame carries one emitter interval, self-describing given the
+frames before it from the same emitter:
+
+    <u64 emitter_id> <u64 seq> <u32 n_names> <u32 n_rows>
+    n_names x ( <u32 local_id> <u16 len> <len B utf-8 name> )
+    n_rows  x ( <i32 local_id> <i32 codec_bucket> <i32 count> )
+
+* ``emitter_id`` is a random u64 minted per emitter process; the
+  receiver keys sequence tracking and the local-id→row map on it.
+* ``seq`` is monotonic from 1 per emitter.  The receiver applies each
+  seq at most once (idempotent re-delivery) and counts gaps.
+* The name dictionary is DELTA encoded: only names first shipped in
+  this frame appear, so steady state pays ~0 dictionary bytes.  Row
+  triples reference emitter-local ids; the receiver interns names into
+  aggregator registry rows and rewrites the id column.
+* Triples are the PR-6 packed ``[n, 3]`` int32 layout verbatim —
+  ``numpy.tobytes()`` little-endian on the way out, ``frombuffer`` on
+  the way in.  Counts are positive and < 2^30 (the packed-row cap), so
+  the receiver-side scatter-add can never overflow mid-merge.
+
+Framing (magic/version/length/CRC) is ops/codec.py's; this module only
+owns the DELTA payload bytes.  Decode is strict: every declared length
+must land exactly on the payload end, and any violation raises
+``WireError`` — which the receiver counts as a decode error and refuses
+to apply, because a mis-split triple array would merge garbage counts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+import numpy as np
+
+# frame ``kind`` byte (ops.codec.encode_frame) for DELTA payloads
+KIND_DELTA = 1
+
+_DELTA_HEAD = struct.Struct("<QQII")
+_NAME_HEAD = struct.Struct("<IH")
+_MAX_NAME_BYTES = 4096
+
+
+class WireError(ValueError):
+    """A structurally invalid DELTA payload (the frame CRC passed, so
+    this is a schema bug or version skew, not line noise)."""
+
+
+@dataclasses.dataclass
+class DeltaFrame:
+    emitter_id: int
+    seq: int
+    names: list  # [(local_id, name), ...] first shipped in this frame
+    packed: np.ndarray  # int32 [n, 3] (local_id, codec_bucket, count)
+
+    @property
+    def samples(self) -> int:
+        return int(self.packed[:, 2].sum(dtype=np.int64))
+
+
+def encode_delta(
+    emitter_id: int, seq: int, names, packed: np.ndarray
+) -> bytes:
+    """Assemble one DELTA payload (see module docstring for the layout)."""
+    packed = np.ascontiguousarray(packed, dtype=np.int32)
+    if packed.ndim != 2 or packed.shape[1] != 3:
+        raise ValueError(
+            f"packed must be [n, 3] (id, bucket, count); got {packed.shape}"
+        )
+    parts = [_DELTA_HEAD.pack(emitter_id, seq, len(names), len(packed))]
+    for local_id, name in names:
+        raw = name.encode("utf-8")
+        if len(raw) > _MAX_NAME_BYTES:
+            raise ValueError(
+                f"metric name {name[:40]!r}... is {len(raw)} B "
+                f"(cap {_MAX_NAME_BYTES})"
+            )
+        parts.append(_NAME_HEAD.pack(local_id, len(raw)))
+        parts.append(raw)
+    if not packed.dtype.isnative:
+        packed = packed.astype("<i4")
+    parts.append(packed.tobytes())
+    return b"".join(parts)
+
+
+def decode_delta(payload: bytes) -> DeltaFrame:
+    """Parse one DELTA payload; raises WireError on any structural
+    violation instead of returning a best guess."""
+    if len(payload) < _DELTA_HEAD.size:
+        raise WireError(
+            f"DELTA payload {len(payload)} B is shorter than its "
+            f"{_DELTA_HEAD.size} B header"
+        )
+    emitter_id, seq, n_names, n_rows = _DELTA_HEAD.unpack_from(payload, 0)
+    off = _DELTA_HEAD.size
+    names = []
+    for _ in range(n_names):
+        if off + _NAME_HEAD.size > len(payload):
+            raise WireError("DELTA name dictionary overruns the payload")
+        local_id, name_len = _NAME_HEAD.unpack_from(payload, off)
+        off += _NAME_HEAD.size
+        if name_len > _MAX_NAME_BYTES or off + name_len > len(payload):
+            raise WireError("DELTA name entry overruns the payload")
+        try:
+            name = payload[off:off + name_len].decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise WireError(f"DELTA name is not utf-8: {e}") from e
+        off += name_len
+        names.append((local_id, name))
+    rows_bytes = n_rows * 12
+    if off + rows_bytes != len(payload):
+        raise WireError(
+            f"DELTA declares {n_rows} rows ({rows_bytes} B) but "
+            f"{len(payload) - off} B remain past the dictionary"
+        )
+    packed = (
+        np.frombuffer(payload, dtype="<i4", count=n_rows * 3, offset=off)
+        .reshape(n_rows, 3)
+        .astype(np.int32)  # native, writable copy: the receiver rewrites
+    )                      # the id column in place
+    return DeltaFrame(
+        emitter_id=emitter_id, seq=seq, names=names, packed=packed
+    )

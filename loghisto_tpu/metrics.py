@@ -60,7 +60,9 @@ from loghisto_tpu.channel import Channel
 from loghisto_tpu.config import DEFAULT_PERCENTILES, MetricConfig
 from loghisto_tpu.obs.spans import NULL_RECORDER
 from loghisto_tpu.ops.codec import compress_np
-from loghisto_tpu.ops.stats import percentiles_sparse, summarize_sparse
+# ops.stats is imported lazily inside the functions that need it: this
+# module is on the base-package import path and federation emitter
+# processes must import it without pulling jax
 from loghisto_tpu.utils.sysstats import default_gauges
 
 logger = logging.getLogger("loghisto_tpu")
@@ -903,6 +905,8 @@ class MetricSystem:
         # folding at collection fixes both.)  The folded sum is the
         # decompressed-representative sum, like the reference's.
         agg_increments = []
+        if histograms:
+            from loghisto_tpu.ops.stats import summarize_sparse
         for name, bucket_counts in histograms.items():
             buckets = np.fromiter(bucket_counts.keys(), dtype=np.int64)
             cnt = np.fromiter(bucket_counts.values(), dtype=np.uint64)
@@ -954,6 +958,10 @@ class MetricSystem:
     def _process_histogram(
         self, name: str, bucket_counts: Mapping[int, int]
     ) -> Dict[str, float]:
+        from loghisto_tpu.ops.stats import (
+            percentiles_sparse, summarize_sparse,
+        )
+
         out: Dict[str, float] = {}
         buckets = np.fromiter(bucket_counts.keys(), dtype=np.int64)
         counts = np.fromiter(bucket_counts.values(), dtype=np.uint64)

@@ -34,6 +34,13 @@ Invariants evaluated (each yields a machine-readable reason dict
     half-open; intervals take the pinned fan-out/spill path.
   * ``recovery_in_progress`` — checkpoint restore + journal replay is
     rebuilding state after a crash.
+  * ``emitter_starvation``   — the federation receiver expects emitters
+    (configured count, or it has heard from some already) but no frame
+    has arrived for more than its starvation window; the fan-in tier is
+    dark while the pod looks otherwise healthy.
+  * ``fed_decode_errors``    — a federation frame failed CRC/schema
+    validation (or tore at connection EOF) recently; corrupt deltas are
+    dropped, never merged (ISSUE 11; latched one stall window).
 
 ``no_commit`` makes the report STALLED; every other reason makes it
 DEGRADED; otherwise OK.  Event-shaped invariants (fan-outs, evictions)
@@ -102,6 +109,8 @@ class HealthWatchdog:
         supervisor=None,
         breaker=None,
         recovery=None,
+        federation=None,
+        federation_starvation_intervals: float = 3.0,
     ):
         self._committer = committer
         self._agg = aggregator
@@ -111,6 +120,12 @@ class HealthWatchdog:
         self._supervisor = supervisor
         self._breaker = breaker
         self._recovery = recovery
+        # federation (ISSUE 11): receiver fan-in starvation + decode
+        # integrity, both read lazily off the receiver's counters
+        self._federation = federation
+        self.federation_starvation_intervals = float(
+            federation_starvation_intervals
+        )
         self.interval = float(interval)
         self.stall_intervals = float(stall_intervals)
         self.backpressure_fraction = float(backpressure_fraction)
@@ -133,6 +148,10 @@ class HealthWatchdog:
             getattr(supervisor, "total_restarts", 0) or 0
         )
         self._restarts_until = 0.0
+        self._fed_errs_seen = int(
+            getattr(federation, "decode_errors", 0) or 0
+        )
+        self._fed_errs_until = 0.0
         # fan-out systems have no committer calling note_commit; fall
         # back to observing the wheel's interval counter at read time
         self._pushed_seen = int(getattr(wheel, "intervals_pushed", 0) or 0)
@@ -280,6 +299,52 @@ class HealthWatchdog:
                 "value": 1.0,
             })
 
+        fed = self._federation
+        if fed is not None:
+            # starvation: the receiver is live, emitters are expected
+            # (configured, or some already spoke), yet no frame for more
+            # than the starvation window — the fan-in tier went dark
+            expecting = (
+                int(getattr(fed, "expected_emitters", 0) or 0) > 0
+                or int(getattr(fed, "frames_received", 0) or 0) > 0
+            )
+            starve_after = (
+                self.federation_starvation_intervals * self.interval
+            )
+            fed_age = fed.last_frame_age_s()
+            if (
+                expecting
+                and getattr(fed, "_started_t", None) is not None
+                and fed_age > starve_after
+            ):
+                reasons.append({
+                    "code": "emitter_starvation",
+                    "detail": (
+                        f"no federation frame for {fed_age:.3f}s "
+                        f"(> {self.federation_starvation_intervals:g} x "
+                        f"{self.interval:g}s) with "
+                        f"{len(fed.emitters)} emitter(s) seen of "
+                        f"{fed.expected_emitters} expected"
+                    ),
+                    "value": fed_age,
+                })
+            # decode errors latch for one stall window like the other
+            # event-shaped invariants
+            fed_errs = int(getattr(fed, "decode_errors", 0) or 0)
+            if fed_errs > self._fed_errs_seen:
+                self._fed_errs_seen = fed_errs
+                self._fed_errs_until = now + self._latch_window
+            if now < self._fed_errs_until:
+                reasons.append({
+                    "code": "fed_decode_errors",
+                    "detail": (
+                        "federation frame(s) failed CRC/schema "
+                        "validation or tore at connection EOF; the "
+                        "corrupt deltas were dropped, not merged"
+                    ),
+                    "value": float(fed_errs),
+                })
+
         down_until = float(getattr(agg, "_device_down_until", 0.0) or 0.0)
         if down_until > now:
             reasons.append({
@@ -325,7 +390,8 @@ class HealthWatchdog:
                      "transfer_drain_lag", "fused_degraded",
                      "subscriber_evictions", "device_cooldown",
                      "thread_restarted", "breaker_open",
-                     "recovery_in_progress"):
+                     "recovery_in_progress", "emitter_starvation",
+                     "fed_decode_errors"):
             ms.register_gauge_func(
                 f"health.{code}",
                 lambda c=code: float(c in self.report().reason_codes()),

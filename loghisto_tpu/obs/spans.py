@@ -41,7 +41,6 @@ import numpy as np
 
 from loghisto_tpu.config import PRECISION
 from loghisto_tpu.ops.codec import compress_np
-from loghisto_tpu.ops.stats import percentiles_sparse
 
 
 class Span(NamedTuple):
@@ -279,6 +278,11 @@ class LatencyHistogram:
                 return 0.0
             buckets = np.fromiter(self._buckets.keys(), dtype=np.int64)
             counts = np.fromiter(self._buckets.values(), dtype=np.int64)
+        # imported here, not at module top: this module sits on the
+        # base-package import path and federation emitters must load it
+        # without pulling jax into their process
+        from loghisto_tpu.ops.stats import percentiles_sparse
+
         return float(percentiles_sparse(
             buckets, counts, np.asarray([q / 100.0]), self.precision
         )[0])

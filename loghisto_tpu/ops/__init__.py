@@ -1,29 +1,50 @@
-"""TPU compute kernels: codec, dense ingest, statistics, sketches."""
+"""TPU compute kernels: codec, dense ingest, statistics, sketches.
+
+The codec names re-export eagerly (codec.py is jax-free at import time);
+the stats names resolve lazily via PEP 562 so that federation emitter
+processes can reach the frame/bucket codecs without importing jax.
+"""
 
 from loghisto_tpu.ops.codec import (
+    FrameError,
+    FrameTruncated,
     compress,
     compress_np,
     compress_scalar,
+    decode_frame,
     decompress,
     decompress_np,
     decompress_scalar,
-)
-from loghisto_tpu.ops.stats import (
-    bucket_representatives,
-    dense_stats,
-    percentiles_sparse,
-    summarize_sparse,
+    encode_frame,
+    iter_frames,
 )
 
-__all__ = [
-    "compress",
-    "compress_np",
-    "compress_scalar",
-    "decompress",
-    "decompress_np",
-    "decompress_scalar",
+_STATS_NAMES = (
     "bucket_representatives",
     "dense_stats",
     "percentiles_sparse",
     "summarize_sparse",
+)
+
+__all__ = [
+    "FrameError",
+    "FrameTruncated",
+    "compress",
+    "compress_np",
+    "compress_scalar",
+    "decode_frame",
+    "decompress",
+    "decompress_np",
+    "decompress_scalar",
+    "encode_frame",
+    "iter_frames",
+    *_STATS_NAMES,
 ]
+
+
+def __getattr__(name):
+    if name in _STATS_NAMES:
+        from loghisto_tpu.ops import stats
+
+        return getattr(stats, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -19,13 +19,21 @@ is what the Pallas/XLA ingest kernels inline).  One deliberate deviation:
 out-of-range buckets *saturate* to +/-32767 instead of wrapping the way Go's
 int16 conversion does — saturation is strictly saner and the difference only
 manifests beyond the documented ~1e142 failure point.
+
+This module also carries the byte-level FRAME codec (versioned header,
+length prefix, CRC32) that wraps packed ``[n, 3]`` cell payloads for the
+federation wire and the binary frame journal.  jax is imported lazily by
+the two device functions only, so federation emitter processes — which
+never touch a device — import this module without paying (or having)
+jax.
 """
 
 from __future__ import annotations
 
 import math
+import struct
+import zlib
 
-import jax.numpy as jnp
 import numpy as np
 
 from loghisto_tpu.config import INT16_BUCKET_LIMIT, PRECISION
@@ -66,10 +74,12 @@ def decompress_np(buckets: np.ndarray, precision: int = PRECISION) -> np.ndarray
     return np.where(buckets < 0, -mag, mag)
 
 
-def compress(values: jnp.ndarray, precision: int = PRECISION) -> jnp.ndarray:
+def compress(values, precision: int = PRECISION):
     """Vectorized compress on device (int32 buckets — int16 only matters for
     storage; the dense accumulator indexes with int32 anyway).  NaN pins
     to bucket 0, like every other tier."""
+    import jax.numpy as jnp
+
     values = jnp.asarray(values)
     values = jnp.where(jnp.isnan(values), 0.0, values)
     mag = jnp.floor(precision * jnp.log1p(jnp.abs(values)) + 0.5)
@@ -77,8 +87,107 @@ def compress(values: jnp.ndarray, precision: int = PRECISION) -> jnp.ndarray:
     return jnp.where(values < 0, -mag, mag).astype(jnp.int32)
 
 
-def decompress(buckets: jnp.ndarray, precision: int = PRECISION) -> jnp.ndarray:
+def decompress(buckets, precision: int = PRECISION):
     """Vectorized decompress on device -> float32 bucket representatives."""
+    import jax.numpy as jnp
+
     buckets = jnp.asarray(buckets)
     mag = jnp.exp(jnp.abs(buckets).astype(jnp.float32) / precision) - 1.0
     return jnp.where(buckets < 0, -mag, mag)
+
+
+# -- byte-frame codec ------------------------------------------------------ #
+#
+# One frame on the wire / in the binary journal:
+#
+#     +----+---+----+-----------+----------+===================+
+#     | LH | v | k  | len (u32) | crc (u32)|  payload (len B)  |
+#     +----+---+----+-----------+----------+===================+
+#      2B   1B  1B      4B          4B       variable
+#
+# little-endian throughout; ``crc`` is CRC32 over (version, kind, payload)
+# so a bit flip anywhere — header fields included, since a flipped length
+# changes which bytes the CRC covers — fails closed with FrameError
+# instead of mis-merging.  ``kind`` namespaces payload schemas
+# (federation/wire.py owns the DELTA schema); unknown kinds decode fine
+# and are the consumer's problem, unknown VERSIONS are this layer's.
+
+FRAME_MAGIC = b"LH"
+FRAME_VERSION = 1
+FRAME_HEADER = struct.Struct("<2sBBII")
+# corrupt length fields must fail the CRC, not allocate gigabytes first
+MAX_FRAME_PAYLOAD = 1 << 28
+
+
+class FrameError(ValueError):
+    """A frame that must not be applied: bad magic, unsupported version,
+    implausible length, or CRC mismatch."""
+
+
+class FrameTruncated(FrameError):
+    """The buffer ends mid-frame.  Streaming decoders treat this as
+    "need more bytes"; at end-of-input it is the torn-tail artifact of a
+    crash mid-write (tolerated by the journal, counted by the wire)."""
+
+
+def _frame_crc(kind: int, payload: bytes) -> int:
+    return zlib.crc32(payload, zlib.crc32(bytes((FRAME_VERSION, kind))))
+
+
+def encode_frame(kind: int, payload: bytes) -> bytes:
+    """Wrap ``payload`` in one framed record (header diagram above)."""
+    if not 0 <= kind <= 0xFF:
+        raise ValueError(f"frame kind must be a u8, got {kind}")
+    if len(payload) > MAX_FRAME_PAYLOAD:
+        raise ValueError(
+            f"frame payload {len(payload)} B exceeds the "
+            f"{MAX_FRAME_PAYLOAD} B cap"
+        )
+    return FRAME_HEADER.pack(
+        FRAME_MAGIC, FRAME_VERSION, kind, len(payload),
+        _frame_crc(kind, payload),
+    ) + payload
+
+
+def decode_frame(buf, offset: int = 0) -> tuple[int, bytes, int]:
+    """Decode one frame at ``buf[offset:]``.  Returns
+    ``(kind, payload, next_offset)``.  Raises FrameTruncated when the
+    buffer ends mid-frame (stream decoders recv more and retry) and
+    FrameError for anything that must never be applied."""
+    end = offset + FRAME_HEADER.size
+    if end > len(buf):
+        raise FrameTruncated(
+            f"{len(buf) - offset} B at offset {offset} is shorter than "
+            f"the {FRAME_HEADER.size} B frame header"
+        )
+    magic, version, kind, length, crc = FRAME_HEADER.unpack(
+        bytes(buf[offset:end])
+    )
+    if magic != FRAME_MAGIC:
+        raise FrameError(f"bad frame magic {magic!r} at offset {offset}")
+    if version != FRAME_VERSION:
+        raise FrameError(f"unsupported frame version {version}")
+    if length > MAX_FRAME_PAYLOAD:
+        raise FrameError(
+            f"frame length {length} exceeds the {MAX_FRAME_PAYLOAD} B cap"
+        )
+    if end + length > len(buf):
+        raise FrameTruncated(
+            f"frame at offset {offset} declares {length} B payload but "
+            f"only {len(buf) - end} B remain"
+        )
+    payload = bytes(buf[end:end + length])
+    if _frame_crc(kind, payload) != crc:
+        raise FrameError(f"frame CRC mismatch at offset {offset}")
+    return kind, payload, end + length
+
+
+def iter_frames(buf):
+    """Yield every ``(kind, payload)`` in a byte buffer of back-to-back
+    frames.  Strict: any corruption — including a torn tail — raises;
+    torn-tolerant consumers (the frame journal) decode by hand and catch
+    FrameTruncated at end-of-buffer."""
+    offset = 0
+    while offset < len(buf):
+        kind, payload, offset = decode_frame(buf, offset)
+        yield kind, payload

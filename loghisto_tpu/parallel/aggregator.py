@@ -1161,6 +1161,26 @@ class TPUAggregator:
         self._enqueue_xfer((kind, ids, values, len(ids), True))
         self.wait_transfers()
 
+    def merge_packed(self, packed: np.ndarray, wait: bool = False) -> None:
+        """Public packed-triple ingest: merge an int32 ``[n, 3]``
+        (row_id, codec_bucket, count) cell array — already in THIS
+        aggregator's row-id space — through the transfer worker's packed
+        path (same device merge, spill guarantees, and wire accounting
+        as the sparse transport's fold).  The federation receiver's
+        drain; scatter-adds are order-independent, so interleaving with
+        local ingest cannot change the aggregate.  ``wait`` blocks until
+        the transfer queue drains (tests; production callers pipeline)."""
+        packed = np.ascontiguousarray(packed, dtype=np.int32)
+        if packed.ndim != 2 or packed.shape[1] != 3:
+            raise ValueError(
+                f"packed cell array must be [n, 3] (id, bucket, count); "
+                f"got shape {packed.shape}"
+            )
+        if len(packed):
+            self._enqueue_xfer(("packed", packed, None, 0, False))
+        if wait:
+            self.wait_transfers()
+
     # -- transfer pipeline ---------------------------------------------- #
 
     def _enqueue_xfer(self, item: tuple) -> None:

@@ -25,6 +25,9 @@ Hook sites wired in this round (see ARCHITECTURE.md for the table):
     journal.append     mangle() over the serialized line (torn/corrupt)
     export.send        submitter send path
     recovery.tick      recovery manager's cadenced checkpoint
+    fed.send           federation emitter's frame send (BacklogSender)
+    fed.accept         federation receiver accept loop, per connection
+    fed.decode         federation receiver, per decoded frame pre-apply
 
 Actions: ``raise`` (InjectedFault), ``delay`` (sleep ``delay_s`` —
 slow-subscriber / slow-device), ``wedge`` (block until

@@ -13,6 +13,11 @@ Redesigned details: one sender thread (the reference uses two goroutines —
 receive/serialize and retry — we serialize on receipt in the receiver
 thread and retry in the sender thread, same observable behavior), and the
 ring is a deque with maxlen which has identical evict-oldest semantics.
+
+The socket/reconnect machinery lives in ``BacklogSender`` so payloads
+that are NOT line-oriented text — the federation tier's binary frames —
+reuse the same backlog/backoff/fresh-dial loop instead of re-implementing
+it; ``Submitter`` is that machinery plus the subscription and serializer.
 """
 
 from __future__ import annotations
@@ -62,60 +67,70 @@ def send_once(
         return e
 
 
-class Submitter:
-    """Receives processed metric sets, serializes them, and attempts
-    delivery to `destination_address` with retry from an evicting backlog."""
+class BacklogSender:
+    """Evicting backlog + fresh-dial best-effort sends + capped-exponential
+    retry cadence — the delivery half of the reference submitter, factored
+    out so any byte payload (graphite lines, OpenTSDB JSON, federation
+    frames) ships through one implementation.
+
+    Payload-agnostic: callers enqueue ready-to-send ``bytes`` via
+    ``_append_to_backlog`` (or ``enqueue``, which also wakes the sender).
+    The sender thread drains head-first on the ``interval`` cadence,
+    switching to the capped-exponential ``backoff`` cadence while the
+    destination is down."""
 
     def __init__(
         self,
-        metric_system: MetricSystem,
-        serializer: Callable[[ProcessedMetricSet], bytes],
         destination_network: str,
         destination_address: tuple[str, int],
+        *,
         backlog_slots: int = BACKLOG_SLOTS,
         dial_timeout: float = DIAL_TIMEOUT_S,
+        interval: float = 60.0,
         backoff=None,
+        fault_site: str = "export.send",
     ):
         if destination_network not in ("tcp", "udp"):
             raise ValueError("destination_network must be 'tcp' or 'udp'")
-        self.metric_system = metric_system
-        self.serializer = serializer
         self.destination_network = destination_network
         self.destination_address = destination_address
         self.dial_timeout = dial_timeout
-        # shared capped-exponential retry cadence: a dead TSDB is re-poked
-        # at growing intervals (capped at the metric interval) instead of
-        # every interval boundary; the first success snaps back to the
-        # interval cadence (resilience/backoff.py)
+        self.interval = float(interval)
+        # shared capped-exponential retry cadence: a dead destination is
+        # re-poked at growing intervals (capped at the send interval)
+        # instead of every interval boundary; the first success snaps
+        # back to the interval cadence (resilience/backoff.py)
         if backoff is None:
             from loghisto_tpu.resilience.backoff import Backoff
 
             backoff = Backoff(
-                base_s=min(1.0, metric_system.interval / 4.0 or 0.25),
-                cap_s=max(metric_system.interval, 1.0),
+                base_s=min(1.0, self.interval / 4.0 or 0.25),
+                cap_s=max(self.interval, 1.0),
             )
         self._backoff = backoff
         self.send_failures = 0
-        # chaos hook: scripted export failures ("export.send")
+        self.bytes_sent = 0
+        # chaos hook: scripted send failures at `fault_site`
+        # ("export.send" for the TSDB path, "fed.send" for federation)
         self.fault_injector = None
+        self._fault_site = fault_site
         self._backlog: deque[bytes] = deque(maxlen=backlog_slots)
         self._backlog_lock = threading.Lock()
-        # survives strike-eviction: one transient stall must not kill the
-        # export path permanently (deliberate improvement over the
-        # reference, whose submitter dies with its evicted channel)
-        self._metric_chan = ResilientSubscription(
-            metric_system.subscribe_to_processed_metrics,
-            metric_system.unsubscribe_from_processed_metrics,
-            backlog_slots,
-        )
         self._shutdown = threading.Event()
-        self._threads: list[threading.Thread] = []
+        self._wake = threading.Event()
+        self._sender_thread: Optional[threading.Thread] = None
 
     # -- backlog ------------------------------------------------------- #
 
     def _append_to_backlog(self, request: bytes) -> None:
         with self._backlog_lock:
             self._backlog.append(request)  # maxlen evicts the oldest
+
+    def enqueue(self, request: bytes) -> None:
+        """Append and wake the sender thread (don't wait for the next
+        interval boundary) — the flush-now path."""
+        self._append_to_backlog(request)
+        self._wake.set()
 
     def retry_backlog(self) -> Optional[Exception]:
         """Drain the backlog head-first; stop at the first failure and
@@ -140,7 +155,7 @@ class Submitter:
         inj = self.fault_injector
         if inj is not None:
             try:
-                inj.check("export.send")
+                inj.check(self._fault_site)
             except Exception as e:  # injected failures follow the
                 self.send_failures += 1  # send_once error contract
                 return e
@@ -150,7 +165,81 @@ class Submitter:
         )
         if err is not None:
             self.send_failures += 1
+        else:
+            self.bytes_sent += len(request)
         return err
+
+    # -- sender lifecycle ----------------------------------------------- #
+
+    def _sender_loop(self) -> None:
+        interval = self.interval
+        while not self._shutdown.is_set():
+            err = self.retry_backlog()
+            if err is not None:
+                logger.debug("submission failed: %s", err)
+                # failed sends re-poke on the capped-exponential cadence
+                tts = self._backoff.next_delay()
+            else:
+                self._backoff.reset()
+                tts = interval - (time.time() % interval)
+            self._wake.wait(timeout=tts)
+            self._wake.clear()
+
+    def backlog_depth(self) -> int:
+        with self._backlog_lock:
+            return len(self._backlog)
+
+    def start_sender(self, name: str = "loghisto-sender") -> None:
+        """Spawn the standalone sender thread (callers that manage their
+        own threads — the Submitter — drive ``_sender_loop`` directly)."""
+        if self._sender_thread is not None:
+            return
+        self._shutdown.clear()
+        self._sender_thread = threading.Thread(
+            target=self._sender_loop, daemon=True, name=name
+        )
+        self._sender_thread.start()
+
+    def stop_sender(self, timeout: float = 5.0) -> None:
+        self._shutdown.set()
+        self._wake.set()
+        t = self._sender_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=timeout)
+        self._sender_thread = None
+
+
+class Submitter(BacklogSender):
+    """Receives processed metric sets, serializes them, and attempts
+    delivery to `destination_address` with retry from an evicting backlog."""
+
+    def __init__(
+        self,
+        metric_system: MetricSystem,
+        serializer: Callable[[ProcessedMetricSet], bytes],
+        destination_network: str,
+        destination_address: tuple[str, int],
+        backlog_slots: int = BACKLOG_SLOTS,
+        dial_timeout: float = DIAL_TIMEOUT_S,
+        backoff=None,
+    ):
+        super().__init__(
+            destination_network, destination_address,
+            backlog_slots=backlog_slots, dial_timeout=dial_timeout,
+            interval=metric_system.interval, backoff=backoff,
+            fault_site="export.send",
+        )
+        self.metric_system = metric_system
+        self.serializer = serializer
+        # survives strike-eviction: one transient stall must not kill the
+        # export path permanently (deliberate improvement over the
+        # reference, whose submitter dies with its evicted channel)
+        self._metric_chan = ResilientSubscription(
+            metric_system.subscribe_to_processed_metrics,
+            metric_system.unsubscribe_from_processed_metrics,
+            backlog_slots,
+        )
+        self._threads: list[threading.Thread] = []
 
     # -- lifecycle ------------------------------------------------------ #
 
@@ -169,23 +258,6 @@ class Submitter:
             except Exception:
                 logger.exception("serializer failed; dropping metric set")
 
-    def _sender_loop(self) -> None:
-        interval = self.metric_system.interval
-        while not self._shutdown.is_set():
-            err = self.retry_backlog()
-            if err is not None:
-                logger.debug("metric submission failed: %s", err)
-                # failed sends re-poke on the capped-exponential cadence
-                tts = self._backoff.next_delay()
-            else:
-                self._backoff.reset()
-                tts = interval - (time.time() % interval)
-            self._shutdown.wait(timeout=tts)
-
-    def backlog_depth(self) -> int:
-        with self._backlog_lock:
-            return len(self._backlog)
-
     def register_gauges(self, ms: Optional[MetricSystem] = None) -> None:
         """Export-path health on the ordinary gauge pipeline."""
         ms = ms if ms is not None else self.metric_system
@@ -197,6 +269,9 @@ class Submitter:
         )
         ms.register_gauge_func(
             "export.BacklogDepth", lambda: float(self.backlog_depth())
+        )
+        ms.register_gauge_func(
+            "export.BytesSent", lambda: float(self.bytes_sent)
         )
 
     def start(self) -> None:
@@ -220,6 +295,7 @@ class Submitter:
     def shutdown(self) -> None:
         """Stop both threads; idempotent (reference submitter.go:152-159)."""
         self._shutdown.set()
+        self._wake.set()
         self._metric_chan.close()
         for t in self._threads:
             if t is not threading.current_thread():

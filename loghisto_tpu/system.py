@@ -54,6 +54,7 @@ class TPUMetricSystem(MetricSystem):
         transport: str = "auto",
         observability=None,
         resilience=None,
+        federation=None,
     ):
         """``retention`` turns on the windowed retention tier:
         ``True`` builds a TimeWheel with the default 60x1 / 60x60 /
@@ -122,7 +123,23 @@ class TPUMetricSystem(MetricSystem):
         journal past the watermark — at most one interval lost across a
         crash.  A ``fault_injector`` in the config scripts deterministic
         chaos faults through the pipeline's hook sites; left None, every
-        hook is a single attribute test."""
+        hook is a single attribute test.
+
+        ``federation`` takes a ``federation.FederationConfig`` (or
+        ``True`` for the defaults) and turns this system into the
+        aggregator pod of a federation tier (ISSUE 11): a TCP
+        ``FederationReceiver`` listens on ``(host, port)`` (port 0 binds
+        an ephemeral one, read back from ``ms.federation.port``) for
+        framed packed-triple deltas from ``FederationEmitter``s running
+        in other processes, interns their metric names through this
+        system's registry, deduplicates frames by per-emitter sequence
+        number, and drains the triples into the same staged ingest and
+        fused commit local samples take — so the federated aggregate is
+        bit-identical to a single process recording everything.  The
+        accept/decode threads run supervised when ``resilience`` is on,
+        ``federation.*`` gauges ride every exporter, and with
+        ``observability`` the health report gains the
+        ``emitter_starvation`` / ``fed_decode_errors`` invariants."""
         super().__init__(
             interval=interval, sys_stats=sys_stats, config=config,
             fast_ingest=fast_ingest,
@@ -343,6 +360,29 @@ class TPUMetricSystem(MetricSystem):
                 injector=self.fault_injector,
             )
 
+        # -- federation tier (ISSUE 11) --------------------------------- #
+        self.federation = None
+        self.federation_config = None
+        if federation is not None and federation is not False:
+            from loghisto_tpu.federation import FederationConfig
+            from loghisto_tpu.federation.receiver import FederationReceiver
+
+            fcfg = (
+                FederationConfig() if federation is True else federation
+            )
+            self.federation_config = fcfg
+            self.federation = FederationReceiver(
+                self.aggregator,
+                host=fcfg.host,
+                port=fcfg.port,
+                journal_path=fcfg.journal_path,
+                replay_on_start=fcfg.replay_on_start,
+                expected_emitters=fcfg.expected_emitters,
+                supervisor=self.supervisor,
+                fault_injector=self.fault_injector,
+            )
+            self.federation.register_gauges(self)
+
         # -- self-observability (ISSUE 9) ------------------------------- #
         self.obs = None            # the SpanRecorder (None when off)
         self.obs_config = None
@@ -372,6 +412,8 @@ class TPUMetricSystem(MetricSystem):
                 self.lifecycle.obs_recorder = rec
             if self.anomaly is not None:
                 self.anomaly.obs_recorder = rec
+            if self.federation is not None:
+                self.federation.obs_recorder = rec
             if self.committer is not None:
                 self.committer.obs_recorder = rec
                 if cfg.dogfood:
@@ -389,6 +431,11 @@ class TPUMetricSystem(MetricSystem):
                     supervisor=self.supervisor,
                     breaker=self.device_breaker,
                     recovery=self.recovery,
+                    federation=self.federation,
+                    federation_starvation_intervals=(
+                        self.federation_config.starvation_intervals
+                        if self.federation_config is not None else 3.0
+                    ),
                 )
                 if self.committer is not None:
                     self.committer.watchdog = self.health
@@ -484,6 +531,8 @@ class TPUMetricSystem(MetricSystem):
                     if self.fault_injector is not None else 0
                 ),
             }
+        if self.federation is not None:
+            dump["federation"] = self.federation.stats()
         dump["health"] = (
             self.health.report().as_dict() if self.health else None
         )
@@ -616,9 +665,18 @@ class TPUMetricSystem(MetricSystem):
                 self._recovered = True
                 self.recovery.recover()
             self.recovery.start()
+        if self.federation is not None:
+            # after recovery (a journal replay must land on restored
+            # state), before the reaper: federated deltas are ordinary
+            # staged ingest, safe as soon as the aggregator exists
+            self.federation.start()
         super().start()
 
     def stop(self) -> None:
+        if self.federation is not None:
+            # first: stop accepting new deltas, then let the close()
+            # below drain whatever already reached the transfer queue
+            self.federation.stop()
         if self.committer is not None:
             self.committer.detach()
         else:

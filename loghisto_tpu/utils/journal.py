@@ -236,3 +236,77 @@ class RawJournal:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+
+
+class FrameJournal:
+    """Binary framed journal: append-only ``(kind, payload)`` records in
+    the SAME frame format the federation wire ships (ops/codec.py:
+    versioned header + length prefix + CRC32) — one codec, two
+    consumers, per the ISSUE 11 satellite.  The federation receiver
+    write-aheads every applied frame here so a receiver restart replays
+    to bit-identical aggregator state.
+
+    Replay is torn-tolerant like the JSONL journal: a frame cut short at
+    end-of-file is the expected crash-mid-append artifact (skipped with
+    a counted warning); CORRUPT bytes mid-file stop the replay there —
+    a byte stream offers no resync point past a bad length field — with
+    the remainder counted as one corrupt record (``strict=True`` raises
+    JournalCorruptError instead).  Both paths feed the same
+    ``journal.CorruptLines`` ledger as the JSONL tier."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "ab")
+        self.frames_appended = 0
+
+    def append(self, kind: int, payload: bytes) -> None:
+        from loghisto_tpu.ops.codec import encode_frame
+
+        frame = encode_frame(kind, payload)
+        with self._lock:
+            self._f.write(frame)
+            self._f.flush()
+            self.frames_appended += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    @staticmethod
+    def replay(path: str, strict: bool = False):
+        """Yield every ``(kind, payload)`` in the journal file (see the
+        class docstring for the torn/corrupt contract)."""
+        from loghisto_tpu.ops.codec import (
+            FrameError, FrameTruncated, decode_frame,
+        )
+
+        with open(path, "rb") as f:
+            buf = f.read()
+        offset = 0
+        while offset < len(buf):
+            try:
+                kind, payload, offset = decode_frame(buf, offset)
+            except FrameTruncated as e:
+                _note_corrupt_line()
+                logger.warning(
+                    "frame journal %s torn at offset %d (%s); skipping "
+                    "tail", path, offset, e,
+                )
+                return
+            except FrameError as e:
+                _note_corrupt_line()
+                if strict:
+                    raise JournalCorruptError(
+                        f"frame journal {path} corrupt at offset {offset}"
+                        f" ({e})"
+                    ) from e
+                logger.warning(
+                    "frame journal %s corrupt at offset %d (%s); "
+                    "abandoning the remaining %d B (no resync point in "
+                    "a binary stream)", path, offset, e, len(buf) - offset,
+                )
+                return
+            yield kind, payload

@@ -1,0 +1,91 @@
+"""Worker process for the multi-process federation conservation test
+(tests/test_federation.py::test_32_emitters_conserve_bit_identical).
+
+Each worker is ONE FederationEmitter in its own interpreter — the real
+deployment shape: a frontend process that records samples, folds them to
+packed triples per interval, and ships frames to the aggregator pod over
+TCP.  The worker is deliberately jax-free (asserted before exit): a
+federation emitter must be importable in processes that have no
+accelerator stack at all.
+
+Phases synchronize over stdin: after draining each phase's frames the
+worker blocks on one line from the parent before recording the next
+phase — the quiet window in which the parent crash-restarts the
+receiver pod (frames are never mid-flight across the crash, so the
+journal replay owes exact conservation, not just at-least-once).
+
+Sample generation is deterministic per (emitter index, phase) and shared
+with the parent, which regenerates the identical stream for the
+single-process oracle.
+
+Usage: python federation_emitter_worker.py <port> <idx> <n_phases>
+Prints "EMITTER <idx> PHASE <p> SENT" per phase and
+"EMITTER <idx> OK <samples_shipped>" on success.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from loghisto_tpu.config import MetricConfig  # noqa: E402
+
+# shared emitter/oracle/aggregator config: precision and bucket_limit
+# must agree for the bit-identical comparison to be meaningful
+CFG = MetricConfig(bucket_limit=512)
+SAMPLES_PER_PHASE = 400
+
+
+def phase_names(idx: int) -> list:
+    # a fleet-shared name, a name shared by each group of emitters, and
+    # a per-emitter name — so interning covers contended and unique rows
+    return [
+        "fed.shared.lat",
+        f"fed.group{idx % 8}.lat",
+        f"fed.e{idx}.bytes",
+    ]
+
+
+def phase_samples(idx: int, phase: int):
+    """Deterministic (name-index array, values array) for one phase."""
+    rng = np.random.default_rng(1000 + idx * 7 + phase)
+    k = rng.integers(0, 3, size=SAMPLES_PER_PHASE)
+    values = rng.uniform(0.01, 5000.0, size=SAMPLES_PER_PHASE)
+    return k.astype(np.int64), values.astype(np.float32)
+
+
+def main() -> int:
+    port, idx, n_phases = (int(a) for a in sys.argv[1:4])
+    from loghisto_tpu.federation.emitter import FederationEmitter
+
+    e = FederationEmitter(
+        ("127.0.0.1", port), interval=0.5, config=CFG,
+        emitter_id=10_000 + idx,
+    )
+    e.start()
+    lids = np.array(
+        [e.local_id(n) for n in phase_names(idx)], dtype=np.int32
+    )
+    for phase in range(n_phases):
+        k, values = phase_samples(idx, phase)
+        e.record_batch(lids[k], values)
+        e.flush()
+        if not e.drain(60.0):
+            print(f"EMITTER {idx} DRAIN-FAIL", flush=True)
+            return 1
+        print(f"EMITTER {idx} PHASE {phase} SENT", flush=True)
+        if phase + 1 < n_phases:
+            if not sys.stdin.readline():  # parent died
+                return 1
+    ok = e.close(drain_timeout=60.0)
+    assert "jax" not in sys.modules, "emitter process imported jax"
+    print(f"EMITTER {idx} OK {e.samples_shipped}", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

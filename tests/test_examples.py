@@ -163,3 +163,22 @@ def test_chaos_drill_example_runs():
     # the crash-scene artifacts recover into a fresh system
     assert "recovery: watermark=" in out
     assert "at-most-one-interval loss: OK" in out
+
+
+def test_federation_demo_example_runs():
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "federation_demo.py")],
+        capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    assert "8 emitter processes launched" in out
+    # live percentile queries served while frames were still arriving
+    # and during the rolling restart of half the fleet
+    assert "live query mid-stream: lat p99 = " in out
+    assert "live query during churn: lat p99 = " in out
+    assert "4 replacement emitters launched" in out
+    # exact conservation across the whole fleet, 0 decode errors
+    assert "0 decode errors" in out
+    assert "conservation exact across 12 emitter processes: OK" in out

@@ -297,9 +297,10 @@ def test_submitter_backoff_gauges_registered():
     sub.register_gauges()
     raw = ms.collect_raw_metrics()
     for g in ("export.RetryBackoffMs", "export.SendFailures",
-              "export.BacklogDepth"):
+              "export.BacklogDepth", "export.BytesSent"):
         assert g in raw.gauges, g
     assert raw.gauges["export.SendFailures"] == 0.0
+    assert raw.gauges["export.BytesSent"] == 0.0
 
     sub._append_to_backlog(b"x\n")
     assert sub.retry_backlog() is not None  # dead destination
