@@ -600,6 +600,25 @@ def main() -> None:
         print(f"bench: federation stage failed: {e}", file=sys.stderr)
     ready9.set()
 
+    # fleet-observability headline (benchmarks/fleet_obs_bench.py has
+    # the per-round table): fan-in throughput cost of wire-v2 stamps +
+    # health piggyback + receiver freshness/rollup accounting at 32
+    # emitters (< 2% budget, roofline-guarded), and the end-to-end
+    # record->queryable p99 from an interval-paced fleet.
+    ready10 = _start_watchdog(300.0, on_timeout=lambda: print(
+        json.dumps(result), flush=True
+    ))
+    try:
+        from benchmarks.fleet_obs_bench import run as fleet_obs_run
+
+        fo = fleet_obs_run(samples_per_cell=1 << 18, repeats=3)
+        result["fleet_obs_overhead_pct"] = fo["fleet_obs_overhead_pct"]
+        result["fleet_freshness_p99_us"] = fo["fleet_freshness_p99_us"]
+        result["fleet_obs_suspect"] = fo["suspect"]
+    except Exception as e:  # never let the extra metric kill the bench
+        print(f"bench: fleet-obs stage failed: {e}", file=sys.stderr)
+    ready10.set()
+
     print(json.dumps(result))
 
 

@@ -1,0 +1,288 @@
+"""Fleet-observability overhead receipts (the ISSUE 12 tentpole): what
+the cross-process trace/freshness/health plane costs on the federation
+fan-in path, and the end-to-end record->queryable latency it measures.
+
+Two runs of the identical 32-emitter x 1k-metric fan-in cell from
+benchmarks/federation_bench.py (threads, not processes — the wire path
+is identical and a 1-core CI box can't exec 32 interpreters without
+measuring mostly spawn overhead):
+
+  baseline  wire v1 frames — the PR-11 format: no capture stamps, no
+            health summary, and the receiver skips anchoring, freshness
+            accounting, and per-emitter rollup entirely.
+  fleet_obs wire v2 frames — capture stamps + piggybacked health JSON
+            on every frame; the receiver anchors clocks, completes a
+            freshness sample per applied frame, and maintains the
+            /fleetz rollup state.
+
+Both runs carry the always-on emitter span ring, so the delta isolates
+exactly the fleet-observability plane.  ``fleet_obs_overhead_pct`` is
+the fan-in throughput loss (best-of-N per mode to shed scheduler
+noise); the PR's acceptance bar is < 2 %.  ``fleet_freshness_p99_us``
+is the receiver's fleet-wide record->queryable p99 over the same run
+(standalone receivers complete freshness at apply — there is no
+snapshot publisher in this topology).
+
+Roofline plausibility guard: fan-in samples/s times bytes/sample is the
+implied loopback byte rate; a number above a generous loopback ceiling
+(20 GB/s) is physically impossible for this topology and marks the run
+suspect rather than reporting it.
+
+Usage: python benchmarks/fleet_obs_bench.py [--samples 524288]
+       [--repeats 5] [--out FLEET_OBS_r12.json]
+Prints one JSON object (save as FLEET_OBS_r*.json); importable as
+``run(...)`` for bench.py's ``fleet_obs_overhead_pct`` /
+``fleet_freshness_p99_us`` headline fields.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import numpy as np
+
+BUCKET_LIMIT = 128
+BATCH = 4096
+N_EMITTERS = 32
+N_METRICS = 1_000
+LOOPBACK_PEAK_BYTES_PER_S = 2e10
+
+
+def _cell(wire_version: int, total_samples: int) -> dict:
+    """One fan-in run at the fixed 32-emitter shape; returns throughput
+    plus (for v2) the receiver's freshness/rollup readings."""
+    from loghisto_tpu.config import MetricConfig
+    from loghisto_tpu.federation.emitter import FederationEmitter
+    from loghisto_tpu.federation.receiver import FederationReceiver
+    from loghisto_tpu.parallel.aggregator import TPUAggregator
+
+    cfg = MetricConfig(bucket_limit=BUCKET_LIMIT)
+    agg = TPUAggregator(num_metrics=N_METRICS + 16, config=cfg)
+    rx = FederationReceiver(agg, recv_bytes=1 << 18)
+    rx.start()
+
+    batches_per_emitter = max(1, total_samples // (N_EMITTERS * BATCH))
+    per_emitter = batches_per_emitter * BATCH
+    total = per_emitter * N_EMITTERS
+
+    def emit(idx: int, out: dict) -> None:
+        e = FederationEmitter(
+            ("127.0.0.1", rx.port), interval=3600.0, config=cfg,
+            emitter_id=idx + 1,
+            backlog_slots=batches_per_emitter + 8,
+            wire_version=wire_version,
+        )
+        rng = np.random.default_rng(idx)
+        lids = np.array(
+            [e.local_id(f"m{j}") for j in range(N_METRICS)],
+            dtype=np.int32,
+        )
+        for _ in range(batches_per_emitter):
+            ids = lids[rng.integers(0, N_METRICS, BATCH)]
+            values = rng.lognormal(3.0, 2.0, BATCH).astype(np.float32)
+            e.record_batch(ids, values)
+            e.flush(heartbeat=False)  # one frame per batch
+        ok = e.drain(timeout=600.0)
+        out[idx] = (ok, e.samples_shipped, e.bytes_sent)
+
+    results: dict = {}
+    threads = [
+        threading.Thread(target=emit, args=(i, results))
+        for i in range(N_EMITTERS)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    deadline = time.monotonic() + 600.0
+    while rx.samples_merged < total and time.monotonic() < deadline:
+        time.sleep(0.005)
+    agg.wait_transfers()
+    wall_s = time.perf_counter() - t0
+
+    assert all(ok for ok, _, _ in results.values()), "emitter drain failed"
+    assert rx.samples_merged == total, (rx.samples_merged, total)
+    st = rx.stats()
+    bytes_per_sample = rx.bytes_received / total
+    sps = total / wall_s
+    cell = {
+        "wire_version": wire_version,
+        "emitters": N_EMITTERS,
+        "metrics": N_METRICS,
+        "samples": total,
+        "frames": rx.frames_received,
+        "wall_s": round(wall_s, 3),
+        "fanin_samples_per_s": round(sps, 1),
+        "bytes_per_sample": round(bytes_per_sample, 3),
+        "suspect": sps * bytes_per_sample > LOOPBACK_PEAK_BYTES_PER_S,
+    }
+    if wire_version >= 2:
+        cell["freshness_samples"] = st["freshness_samples"]
+        cell["freshness_p99_us"] = round(
+            rx.fleet_freshness.percentile_host(99.0), 1
+        )
+        cell["fleet_emitters"] = len(rx.fleet_report()["emitters"])
+    rx.stop()
+    agg.close()
+    return cell
+
+
+def _paced_cell(seconds: float = 2.0, interval: float = 0.05) -> dict:
+    """Interval-paced run for the freshness headline.  The saturated
+    cell flushes each batch the moment it's recorded, so its freshness
+    collapses to wire transit (~0 against the clock anchor); here the
+    emitter's own ticker ships frames, so a sample's record->queryable
+    latency includes the staging dwell until its interval's flush —
+    what freshness means in production."""
+    from loghisto_tpu.config import MetricConfig
+    from loghisto_tpu.federation.emitter import FederationEmitter
+    from loghisto_tpu.federation.receiver import FederationReceiver
+    from loghisto_tpu.parallel.aggregator import TPUAggregator
+
+    n_emitters = 8
+    cfg = MetricConfig(bucket_limit=BUCKET_LIMIT)
+    agg = TPUAggregator(num_metrics=N_METRICS + 16, config=cfg)
+    rx = FederationReceiver(agg, recv_bytes=1 << 18)
+    rx.start()
+
+    def emit(idx: int, out: dict) -> None:
+        e = FederationEmitter(
+            ("127.0.0.1", rx.port), interval=interval, config=cfg,
+            emitter_id=idx + 1,
+        )
+        e.start()
+        rng = np.random.default_rng(idx)
+        lids = np.array(
+            [e.local_id(f"m{j}") for j in range(N_METRICS)],
+            dtype=np.int32,
+        )
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            ids = lids[rng.integers(0, N_METRICS, 512)]
+            values = rng.lognormal(3.0, 2.0, 512).astype(np.float32)
+            e.record_batch(ids, values)
+            time.sleep(0.01)
+        out[idx] = e.close(drain_timeout=60.0)
+
+    results: dict = {}
+    threads = [
+        threading.Thread(target=emit, args=(i, results))
+        for i in range(n_emitters)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    deadline = time.monotonic() + 60.0
+    while rx.stats()["freshness_pending"] and time.monotonic() < deadline:
+        time.sleep(0.01)
+    st = rx.stats()
+    cell = {
+        "emitters": n_emitters,
+        "interval_s": interval,
+        "freshness_samples": st["freshness_samples"],
+        "freshness_p50_us": round(
+            rx.fleet_freshness.percentile_host(50.0), 1
+        ),
+        "freshness_p99_us": round(
+            rx.fleet_freshness.percentile_host(99.0), 1
+        ),
+        "drained": all(results.values()),
+    }
+    rx.stop()
+    agg.close()
+    return cell
+
+
+def run(samples_per_cell: int = 1 << 19, repeats: int = 5) -> dict:
+    """Alternate baseline/fleet-obs runs, best-of-``repeats`` per mode.
+    On a shared/1-core box the run-to-run spread of a 32-thread fan-in
+    is far wider than the true plane cost, so the design sheds noise
+    three ways: both code paths warm up before any timed run, the
+    within-round order flips every round (drift hits both modes
+    equally), and each mode reports its best round (the least-preempted
+    observation of the same fixed workload)."""
+    _cell(1, samples_per_cell // 4)
+    _cell(2, samples_per_cell // 4)
+    base_cells, obs_cells = [], []
+    for r in range(repeats):
+        order = (1, 2) if r % 2 == 0 else (2, 1)
+        for wv in order:
+            (base_cells if wv == 1 else obs_cells).append(
+                _cell(wv, samples_per_cell)
+            )
+        print(
+            f"fleet_obs_bench: round {r + 1}/{repeats}: "
+            f"v1 {base_cells[-1]['fanin_samples_per_s']:>12.0f} sps, "
+            f"v2 {obs_cells[-1]['fanin_samples_per_s']:>12.0f} sps",
+            file=sys.stderr,
+        )
+    best_base = max(base_cells, key=lambda c: c["fanin_samples_per_s"])
+    best_obs = max(obs_cells, key=lambda c: c["fanin_samples_per_s"])
+    overhead_pct = 100.0 * (
+        1.0 - best_obs["fanin_samples_per_s"]
+        / best_base["fanin_samples_per_s"]
+    )
+    suspect = best_base["suspect"] or best_obs["suspect"]
+    paced = _paced_cell()
+    print(
+        f"fleet_obs_bench: overhead {overhead_pct:+.2f}%, paced "
+        f"freshness p99 {paced['freshness_p99_us']:.0f}us "
+        f"over {paced['freshness_samples']} frames",
+        file=sys.stderr,
+    )
+    return {
+        "bench": "fleet_obs_overhead",
+        "batch": BATCH,
+        "bucket_limit": BUCKET_LIMIT,
+        "repeats": repeats,
+        "baseline": base_cells,
+        "fleet_obs": obs_cells,
+        "paced": paced,
+        "fleet_obs_overhead_pct": (
+            None if suspect else round(overhead_pct, 2)
+        ),
+        "fleet_freshness_p99_us": paced["freshness_p99_us"],
+        "wire_bytes_per_sample_delta": round(
+            best_obs["bytes_per_sample"] - best_base["bytes_per_sample"], 3
+        ),
+        "suspect": suspect,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--samples", type=int, default=1 << 19,
+                        help="samples per run")
+    parser.add_argument("--repeats", type=int, default=5,
+                        help="runs per mode (best-of)")
+    parser.add_argument("--tpu", action="store_true",
+                        help="keep the configured (TPU) platform instead "
+                             "of forcing CPU")
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args(argv)
+
+    import jax
+
+    if not args.tpu:
+        jax.config.update("jax_platforms", "cpu")
+    result = run(samples_per_cell=args.samples, repeats=args.repeats)
+    text = json.dumps(result, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -195,6 +195,10 @@ class IntervalCommitter:
         self.obs_recorder = NULL_RECORDER
         self.self_observer = None
         self.watchdog = None
+        # fleet observability (ISSUE 12): the federation receiver's
+        # note_publish — pending freshness samples complete the moment
+        # the interval snapshot becomes queryable
+        self.freshness_hook = None
 
         # resilience (ISSUE 10), installed by TPUMetricSystem
         # (resilience=...): the supervisor respawns a crashed bridge,
@@ -316,6 +320,13 @@ class IntervalCommitter:
                 pass
         if self.watchdog is not None:
             self.watchdog.note_commit(seq)
+        if self.freshness_hook is not None:
+            # federated frames applied before this commit are now
+            # queryable: close their record→queryable freshness samples
+            try:
+                self.freshness_hook(seq)
+            except Exception:  # pragma: no cover - defensive
+                pass
         if self.self_observer is not None:
             # dogfooding: this interval's closed spans re-enter through
             # the normal histogram() path as obs.<stage>.LatencyUs
@@ -668,10 +679,17 @@ class IntervalCommitter:
                     )
                     run(self._fused_snap, final=True)
 
-    def attach(self, ms: MetricSystem, channel_capacity: int = 8) -> None:
+    def attach(self, ms: MetricSystem, channel_capacity: int = 64) -> None:
         """Subscribe ONCE behind the raw boundary for both consumers —
         strike-eviction resilient, same recovery contract as the
-        journal/exporters."""
+        journal/exporters.
+
+        The bridge is the system's only path from raw interval to
+        queryable snapshot: an interval shed here permanently loses its
+        histogram samples.  The channel is therefore deep enough to ride
+        out multi-second scheduler stalls (64 intervals) and let the
+        bridge catch up afterwards; sustained overload still sheds
+        rather than blocking the reaper."""
         if self._thread is not None:
             raise RuntimeError("already attached")
         self.warmup()

@@ -47,6 +47,10 @@ class FederationConfig:
         recovery instead (replaying on top would double count).
       starvation_intervals: how many system intervals of frame silence
         before ``emitter_starvation`` trips.
+      skew_tolerance_s: how far an emitter's wall clock may diverge
+        from its monotonic clock (since its anchor frame) before the
+        ``emitter_clock_skew`` invariant trips and the emitter lands in
+        ``/fleetz``'s ``clock_skew`` flag list (ISSUE 12).
     """
 
     host: str = "127.0.0.1"
@@ -55,6 +59,7 @@ class FederationConfig:
     journal_path: Optional[str] = None
     replay_on_start: bool = False
     starvation_intervals: float = 3.0
+    skew_tolerance_s: float = 1.0
 
 
 def __getattr__(name):

@@ -38,6 +38,7 @@ import numpy as np
 from loghisto_tpu._native import fold_packed, pack_cells
 from loghisto_tpu.config import MetricConfig
 from loghisto_tpu.federation import wire
+from loghisto_tpu.obs.spans import LatencyHistogram, SpanRecorder
 from loghisto_tpu.ops.codec import encode_frame
 from loghisto_tpu.submitter import BACKLOG_SLOTS, BacklogSender
 
@@ -54,6 +55,9 @@ class FederationEmitter:
         dial_timeout: float = 5.0,
         backoff=None,
         fault_injector=None,
+        wire_version: int = 2,
+        obs_capacity: int = 1024,
+        restarts: int = 0,
     ):
         """``address`` is the receiver's (host, port).  ``interval`` is
         the flush/ship cadence.  ``config`` must agree with the
@@ -63,8 +67,21 @@ class FederationEmitter:
         clipped to ``bucket_limit`` at fold time like every other
         transport.  ``backlog_slots`` defaults wider than the TSDB
         submitter's 60 — a federation frame is an interval of unique
-        cells, cheap to hold, expensive to lose."""
+        cells, cheap to hold, expensive to lose.
+
+        ``wire_version`` picks the frame kind: 2 (default) stamps every
+        frame with capture timestamps and piggybacks a health summary
+        at most once per ``health_interval_s`` (frames in between carry
+        an empty health blob and the receiver keeps the last one — the
+        summary changes at ~1 Hz, while the JSON encode/decode per
+        frame is the dominant wire-v2 cost at high frame rates); 1
+        emits the PR-11 format for old receivers.  ``restarts`` seeds
+        the restart counter shipped in the health summary (a supervisor
+        that respawns this process passes its attempt count)."""
+        if wire_version not in (1, 2):
+            raise ValueError(f"wire_version must be 1 or 2, got {wire_version}")
         self.config = config
+        self.wire_version = int(wire_version)
         self.interval = float(interval)
         self.emitter_id = (
             int(emitter_id) if emitter_id is not None
@@ -76,6 +93,7 @@ class FederationEmitter:
             interval=self.interval, backoff=backoff, fault_site="fed.send",
         )
         self._sender.fault_injector = fault_injector
+        self.fault_injector = fault_injector
         self._lock = threading.Lock()
         self._flush_lock = threading.Lock()
         self._names: dict[str, int] = {}     # name -> emitter-local id
@@ -90,6 +108,24 @@ class FederationEmitter:
         self._ticker: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._attached = None  # (ResilientSubscription, thread)
+        # fleet-observability plane: capture stamps for the interval in
+        # flight (first staged sample since the last flush; None when
+        # nothing landed yet), own span ring (jax-free, like everything
+        # else on this path), and per-stage latency histograms whose
+        # p99s ride in the health summary
+        self._capture_mono_ns: Optional[int] = None
+        self._capture_wall_ns: Optional[int] = None
+        self.obs = SpanRecorder(obs_capacity)
+        self.stage_latency = {
+            "fold": LatencyHistogram(config.precision),
+            "encode": LatencyHistogram(config.precision),
+        }
+        self.restarts = int(restarts)
+        self._started_mono = time.monotonic()
+        # health piggyback cadence: the summary rides at most this often
+        # (0 ships it on every frame, as chaos drills want)
+        self.health_interval_s = 1.0
+        self._health_shipped_mono = float("-inf")
 
     # -- recording ------------------------------------------------------ #
 
@@ -118,6 +154,8 @@ class FederationEmitter:
         if ids.shape != values.shape:
             raise ValueError("ids and values must have the same shape")
         with self._lock:
+            if self._capture_mono_ns is None:
+                self._stamp_capture_locked()
             self._staged_ids.append(ids)
             self._staged_values.append(values)
             self.samples_recorded += len(ids)
@@ -172,8 +210,46 @@ class FederationEmitter:
                             count=len(buckets))
             cells = pack_cells(np.full(len(b), lid, dtype=np.int64), b, c)
             with self._lock:
+                if self._capture_mono_ns is None:
+                    self._stamp_capture_locked()
                 self._staged_cells.append(cells)
                 self.samples_recorded += int(c.sum())
+
+    # -- clocks / health -------------------------------------------------- #
+
+    def _wall_ns(self) -> int:
+        """Wall clock for wire stamps; honors an injected ``clock_step``
+        offset so chaos drills can step this emitter's wall clock
+        without touching the host."""
+        ns = time.time_ns()
+        inj = self.fault_injector
+        if inj is not None:
+            off = getattr(inj, "clock_offset", None)
+            if off is not None:
+                ns += int(off() * 1e9)
+        return ns
+
+    def _stamp_capture_locked(self) -> None:
+        self._capture_mono_ns = time.monotonic_ns()
+        self._capture_wall_ns = self._wall_ns()
+
+    def health_summary(self) -> dict:
+        """Compact health summary piggybacked on every v2 frame: stage
+        p99s (via the jax-free percentile mirror — this process never
+        loads jax), backlog depth, send failures, restart count, and
+        uptime.  A few hundred bytes of JSON per frame."""
+        return {
+            "p99_us": {
+                stage: round(hist.percentile_host(99.0), 1)
+                for stage, hist in self.stage_latency.items()
+            },
+            "backlog": self._sender.backlog_depth(),
+            "fail": self._sender.send_failures,
+            "restarts": self.restarts,
+            "up_s": round(time.monotonic() - self._started_mono, 1),
+            "frames": self.frames_shipped,
+            "samples": self.samples_shipped,
+        }
 
     # -- flush / ship --------------------------------------------------- #
 
@@ -191,14 +267,28 @@ class FederationEmitter:
             return self._flush_locked(heartbeat)
 
     def _flush_locked(self, heartbeat: bool) -> int:
+        inj = self.fault_injector
+        if inj is not None:
+            inj.check("fed.flush")
+        flush_t0 = time.perf_counter_ns()
         with self._lock:
             ids = self._staged_ids
             values = self._staged_values
             cells = self._staged_cells
             names = self._names_unsent
+            mono_ns = self._capture_mono_ns
+            wall_ns = self._capture_wall_ns
             self._staged_ids, self._staged_values = [], []
             self._staged_cells = []
             self._names_unsent = []
+            self._capture_mono_ns = None
+            self._capture_wall_ns = None
+        # this seq is ours: _seq only advances under _flush_lock, which
+        # the caller holds — so the flow id can label the fold/encode
+        # spans before the frame exists
+        seq = self._seq + 1
+        flow = wire.fed_flow_id(self.emitter_id, seq)
+        fold_t0 = time.perf_counter_ns()
         parts = list(cells)
         if ids:
             parts.append(fold_packed(
@@ -212,13 +302,42 @@ class FederationEmitter:
             if not heartbeat and not names:
                 return 0
             packed = np.empty((0, 3), dtype=np.int32)
-        self._seq += 1
-        seq = self._seq
-        payload = wire.encode_delta(self.emitter_id, seq, names, packed)
-        self._sender.enqueue(encode_frame(wire.KIND_DELTA, payload))
+        fold_t1 = time.perf_counter_ns()
+        self.obs.record("fed.fold", fold_t0, fold_t1, seq, flow)
+        self.stage_latency["fold"].add((fold_t1 - fold_t0) / 1e3)
+        self._seq = seq
+        # empty heartbeats stamp at flush time: there was no first
+        # sample, so "capture" degenerates to "now" and the freshness
+        # sample measures pure pipeline latency
+        if mono_ns is None:
+            mono_ns = time.monotonic_ns()
+            wall_ns = self._wall_ns()
+        enc_t0 = time.perf_counter_ns()
+        if self.wire_version >= 2:
+            health = None
+            now_mono = time.monotonic()
+            if now_mono - self._health_shipped_mono >= self.health_interval_s:
+                health = self.health_summary()
+                self._health_shipped_mono = now_mono
+            payload = wire.encode_delta2(
+                self.emitter_id, seq, names, packed,
+                mono_ns, wall_ns, health,
+            )
+            kind = wire.KIND_DELTA2
+        else:
+            payload = wire.encode_delta(self.emitter_id, seq, names, packed)
+            kind = wire.KIND_DELTA
+        frame = encode_frame(kind, payload)
+        enc_t1 = time.perf_counter_ns()
+        self.obs.record("fed.encode", enc_t0, enc_t1, seq, flow)
+        self.stage_latency["encode"].add((enc_t1 - enc_t0) / 1e3)
+        self._sender.enqueue(frame)
         samples = int(packed[:, 2].sum(dtype=np.int64))
         self.frames_shipped += 1
         self.samples_shipped += samples
+        self.obs.record(
+            "fed.flush", flush_t0, time.perf_counter_ns(), seq, flow
+        )
         return samples
 
     def drain(self, timeout: float = 10.0) -> bool:

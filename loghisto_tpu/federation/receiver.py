@@ -55,6 +55,7 @@ from typing import Optional
 import numpy as np
 
 from loghisto_tpu.federation import wire
+from loghisto_tpu.obs.spans import LatencyHistogram
 from loghisto_tpu.ops.codec import (
     FrameError, FrameTruncated, decode_frame,
 )
@@ -75,6 +76,9 @@ ROW_SHED = -1
 # high-water mark has advanced this far past their arrival
 MAX_PARKED_ROWS = 1 << 16
 PARK_SEQ_AGE = 64
+# host-side freshness ledger bound (the bit-identity oracle's input);
+# past this the histograms keep counting but the ledger stops
+FRESHNESS_LEDGER_CAP = 1 << 16
 
 
 class _NullSpan:
@@ -89,6 +93,9 @@ class _NullRecorder:
     def span(self, *_a, **_k):
         return _NullSpan()
 
+    def record(self, *_a, **_k):
+        pass
+
 
 _NULL_RECORDER = _NullRecorder()
 
@@ -99,6 +106,9 @@ class _EmitterState:
     __slots__ = (
         "last_seq", "seen", "row_map", "parked", "parked_rows",
         "last_frame_t", "frames", "samples", "duplicates", "gaps",
+        # fleet-observability plane (v2 frames only)
+        "e_mono0", "r_mono0", "e_wall0", "last_e_mono", "skew_ns",
+        "health", "health_t", "freshness", "wire_v",
     )
 
     def __init__(self):
@@ -115,6 +125,19 @@ class _EmitterState:
         self.samples = 0
         self.duplicates = 0
         self.gaps = 0
+        # clock anchors: emitter monotonic/wall at first v2 frame of
+        # this emitter incarnation, paired with the receiver monotonic
+        # at arrival.  All lag/freshness math runs on monotonic deltas
+        # against these; the wall stamp only feeds the skew detector.
+        self.e_mono0: Optional[int] = None
+        self.r_mono0 = 0
+        self.e_wall0 = 0
+        self.last_e_mono = 0
+        self.skew_ns = 0  # (wall delta) - (mono delta) since anchor
+        self.health: Optional[dict] = None
+        self.health_t = 0.0
+        self.freshness = LatencyHistogram()
+        self.wire_v = 1
 
 
 class FederationReceiver:
@@ -165,6 +188,22 @@ class FederationReceiver:
         self.connections_active = 0
         # frames/s gauge state: (monotonic t, frames_received) at last read
         self._rate_mark = (time.monotonic(), 0)
+        # -- fleet-observability plane -------------------------------- #
+        self.frames_v1 = 0          # legacy frames applied (no stamps)
+        self.fleet_freshness = LatencyHistogram()
+        # applied-but-not-yet-queryable frames: (emitter_id,
+        # apply_mono_ns, capture->apply latency ns).  A wired committer
+        # (``has_publisher``) completes these at snapshot publish via
+        # note_publish(); standalone receivers complete at apply time.
+        self._pending: list = []
+        self.has_publisher = False
+        # host-side oracle ledger of completed freshness samples (µs)
+        self.freshness_values: list = []
+        self.freshness_dropped = 0
+        # thresholds read by fleet_report()/watchdog; system wiring
+        # overwrites from FederationConfig
+        self.starvation_s = 3.0
+        self.skew_tolerance_s = 1.0
 
     # -- lifecycle ------------------------------------------------------ #
 
@@ -330,10 +369,15 @@ class FederationReceiver:
                 inj.check("fed.decode")
             except Exception as e:
                 raise wire.WireError(f"injected decode fault: {e}") from e
-        if kind != wire.KIND_DELTA:
+        if kind not in (wire.KIND_DELTA, wire.KIND_DELTA2):
             raise wire.WireError(f"unknown frame kind {kind}")
-        with self.obs_recorder.span("fed.apply"):
-            delta = wire.decode_delta(payload)
+        t0 = time.perf_counter_ns()
+        delta = wire.decode_payload(kind, payload)
+        flow = wire.fed_flow_id(delta.emitter_id, delta.seq)
+        self.obs_recorder.record(
+            "fed.decode", t0, time.perf_counter_ns(), None, flow
+        )
+        with self.obs_recorder.span("fed.apply", flow=flow):
             if self._journal is not None:
                 # write-ahead, before apply: replay after a crash
                 # re-applies through the same seq dedup, so the journal
@@ -343,8 +387,12 @@ class FederationReceiver:
 
     # -- apply ---------------------------------------------------------- #
 
-    def _apply_delta(self, delta: wire.DeltaFrame) -> None:
+    def _apply_delta(self, delta: wire.DeltaFrame, live: bool = True) -> None:
         agg = self.aggregator
+        flow = wire.fed_flow_id(delta.emitter_id, delta.seq)
+        now_mono_ns = time.monotonic_ns()
+        fresh_ns = None  # completed-at-apply freshness (no publisher)
+        newly_parked = False
         with self._lock:
             state = self.emitters.get(delta.emitter_id)
             if state is None:
@@ -363,6 +411,34 @@ class FederationReceiver:
                     state.row_map = grown
                 state.row_map[local_id] = agg._id_for(name)
             state.last_frame_t = time.monotonic()
+            # clock anchors update on EVERY live v2 frame, duplicates
+            # included — any arrival proves liveness and carries the
+            # freshest clock/health readings.  Replayed frames are
+            # excluded: their stamps describe a past incarnation and
+            # would anchor emitter clocks against the wrong receiver
+            # clock.
+            if delta.mono_ns is not None and live:
+                state.wire_v = 2
+                if state.e_mono0 is None or delta.mono_ns < state.e_mono0:
+                    # first v2 frame from this emitter incarnation, or
+                    # its monotonic clock reset (process restart):
+                    # (re-)anchor both clock pairs here
+                    state.e_mono0 = delta.mono_ns
+                    state.r_mono0 = now_mono_ns
+                    state.e_wall0 = delta.wall_ns
+                    state.last_e_mono = delta.mono_ns
+                state.last_e_mono = max(state.last_e_mono, delta.mono_ns)
+                # a wall-clock step (NTP slew, fault injection) shows as
+                # wall advancing at a different rate than monotonic;
+                # lag/freshness never read the wall clock so a backward
+                # step can only trip the skew flag, never go negative
+                state.skew_ns = (
+                    (delta.wall_ns - state.e_wall0)
+                    - (delta.mono_ns - state.e_mono0)
+                )
+                if delta.health is not None:
+                    state.health = delta.health
+                    state.health_t = time.monotonic()
             seq = delta.seq
             merges: list = []
             if seq in state.seen or seq <= state.last_seq - SEQ_WINDOW:
@@ -389,14 +465,43 @@ class FederationReceiver:
                     state.seen = {s for s in state.seen if s > floor}
                 self.frames_received += 1
                 state.frames += 1
+                if delta.mono_ns is None:
+                    self.frames_v1 += 1
+                elif live:
+                    # capture -> apply latency via the monotonic anchor
+                    # pair; clamped, because transit jitter can make the
+                    # anchor-predicted capture time land marginally
+                    # after "now" for the fastest frames
+                    base_ns = max(
+                        0,
+                        (now_mono_ns - state.r_mono0)
+                        - (delta.mono_ns - state.e_mono0),
+                    )
+                    if self.has_publisher:
+                        self._pending.append(
+                            (delta.emitter_id, now_mono_ns, base_ns)
+                        )
+                    else:
+                        fresh_ns = base_ns
+                parked_before = state.parked_rows
                 if len(delta.packed):
                     self._map_rows_locked(state, delta.packed, merges)
+                newly_parked = state.parked_rows > parked_before
             # a frame (even a duplicate) may have carried the dictionary
             # entries parked rows were waiting on
             if state.parked:
                 self._resolve_parked_locked(state, merges)
-        for packed in merges:
-            agg.merge_packed(packed)
+        if merges:
+            with self.obs_recorder.span("fed.merge", flow=flow):
+                for packed in merges:
+                    agg.merge_packed(packed)
+        if newly_parked:
+            # instantaneous marker: this frame parked rows on a missing
+            # dictionary entry
+            t = time.perf_counter_ns()
+            self.obs_recorder.record("fed.park", t, t, None, flow)
+        if fresh_ns is not None:
+            self._complete_freshness(delta.emitter_id, fresh_ns)
 
     def _map_rows_locked(self, state: _EmitterState, packed, merges) -> None:
         """Rewrite the local-id column through ``row_map``; merge the
@@ -474,6 +579,67 @@ class FederationReceiver:
         state.parked = still
         state.parked_rows = sum(len(p) for _, p in still)
 
+    # -- freshness (record -> queryable) ---------------------------------- #
+
+    def _complete_freshness(self, emitter_id: int, fresh_ns: int) -> None:
+        """One frame became queryable ``fresh_ns`` after its first
+        sample was recorded: feed the fleet and per-emitter log-bucket
+        histograms, the host-side oracle ledger, and (when wired into a
+        system) the ordinary ``fed.FreshnessUs`` histogram path."""
+        us = fresh_ns / 1e3
+        self.fleet_freshness.add(us)
+        with self._lock:
+            state = self.emitters.get(emitter_id)
+            if len(self.freshness_values) < FRESHNESS_LEDGER_CAP:
+                self.freshness_values.append(us)
+            else:
+                self.freshness_dropped += 1
+        if state is not None:
+            state.freshness.add(us)
+        ms = getattr(self, "_ms", None)
+        if ms is not None:
+            ms.histogram("fed.FreshnessUs", us)
+            ms.histogram(f"fed.emitter.{emitter_id:016x}.FreshnessUs", us)
+
+    def note_publish(self, seq=None) -> int:
+        """Snapshot-publish hook: the committer calls this right after
+        an interval's aggregate became queryable.  Every frame applied
+        since the previous publish completes its freshness sample here
+        (capture->apply latency from the wire stamps, plus apply->
+        publish measured receiver-side).  Returns the number of frames
+        completed."""
+        now_ns = time.monotonic_ns()
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for emitter_id, apply_ns, base_ns in pending:
+            self._complete_freshness(
+                emitter_id, base_ns + (now_ns - apply_ns)
+            )
+        return len(pending)
+
+    def oldest_pending_age_s(self) -> float:
+        """Age of the oldest applied-but-unpublished frame — the
+        ``fleet_freshness_stall`` invariant's input.  0 when nothing is
+        pending (an idle fleet is not a stalled fleet)."""
+        now_ns = time.monotonic_ns()
+        with self._lock:
+            if not self._pending:
+                return 0.0
+            return (now_ns - min(p[1] for p in self._pending)) / 1e9
+
+    def freshness_totals(self, budget_us: float, emitter_id=None):
+        """(total, over-budget) sample counts from the freshness
+        histograms — the ``freshness`` SLO-burn rule's observation."""
+        if emitter_id is None:
+            hist = self.fleet_freshness
+        else:
+            with self._lock:
+                state = self.emitters.get(emitter_id)
+            if state is None:
+                return 0, 0
+            hist = state.freshness
+        return hist.count, hist.count_above(budget_us)
+
     # -- journal replay -------------------------------------------------- #
 
     def replay_journal(self, path: Optional[str] = None) -> int:
@@ -489,10 +655,14 @@ class FederationReceiver:
             raise ValueError("no journal_path configured or given")
         n = 0
         for kind, payload in FrameJournal.replay(path):
-            if kind != wire.KIND_DELTA:
+            if kind not in (wire.KIND_DELTA, wire.KIND_DELTA2):
                 continue
             try:
-                self._apply_delta(wire.decode_delta(payload))
+                # live=False: a replayed frame's stamps describe a past
+                # incarnation — rebuilding state must not fabricate
+                # freshness samples
+                self._apply_delta(wire.decode_payload(kind, payload),
+                                  live=False)
             except wire.WireError:
                 with self._lock:
                     self.decode_errors += 1
@@ -503,16 +673,41 @@ class FederationReceiver:
 
     # -- health / gauges ------------------------------------------------- #
 
+    def _lag_locked(self, state: _EmitterState, now_mono_ns: int) -> float:
+        """Per-emitter lag in seconds, computed from MONOTONIC deltas
+        against the anchor pair so a wall-clock step on either side can
+        never drive it negative; clamped anyway because transit jitter
+        on the anchor frame can predict a capture marginally in the
+        future.  v1 emitters (no stamps) fall back to arrival age."""
+        if state.e_mono0 is not None:
+            lag_ns = (
+                (now_mono_ns - state.r_mono0)
+                - (state.last_e_mono - state.e_mono0)
+            )
+            return max(0.0, lag_ns / 1e9)
+        return max(0.0, time.monotonic() - state.last_frame_t)
+
     def max_emitter_lag_s(self) -> float:
-        """Age of the STALEST emitter's last frame (0 with no emitters):
-        the fleet-wide freshness bound the lag gauge and the starvation
+        """Lag of the STALEST emitter (0 with no emitters): the
+        fleet-wide freshness bound the lag gauge and the starvation
         invariant read."""
-        now = time.monotonic()
+        now_ns = time.monotonic_ns()
         with self._lock:
             if not self.emitters:
                 return 0.0
             return max(
-                now - s.last_frame_t for s in self.emitters.values()
+                self._lag_locked(s, now_ns) for s in self.emitters.values()
+            )
+
+    def max_emitter_skew_s(self) -> float:
+        """Largest absolute wall-vs-monotonic divergence any emitter
+        has shown since its clock anchor — the ``emitter_clock_skew``
+        invariant's input."""
+        with self._lock:
+            if not self.emitters:
+                return 0.0
+            return max(
+                abs(s.skew_ns) / 1e9 for s in self.emitters.values()
             )
 
     def last_frame_age_s(self) -> float:
@@ -540,6 +735,7 @@ class FederationReceiver:
         return (frames - f0) / dt
 
     def stats(self) -> dict:
+        now_ns = time.monotonic_ns()
         with self._lock:
             per_emitter = {
                 f"{eid:016x}": {
@@ -548,18 +744,21 @@ class FederationReceiver:
                     "samples": s.samples,
                     "duplicates": s.duplicates,
                     "gaps": s.gaps,
-                    "lag_s": round(
-                        time.monotonic() - s.last_frame_t, 3
-                    ),
+                    "parked_rows": s.parked_rows,
+                    "wire_v": s.wire_v,
+                    "lag_s": round(self._lag_locked(s, now_ns), 3),
+                    "skew_s": round(s.skew_ns / 1e9, 6),
                 }
                 for eid, s in self.emitters.items()
             }
+            pending = len(self._pending)
         return {
             "port": self.port,
             "connections_active": self.connections_active,
             "connections_total": self.connections_total,
             "frames_received": self.frames_received,
             "frames_replayed": self.frames_replayed,
+            "frames_v1": self.frames_v1,
             "bytes_received": self.bytes_received,
             "decode_errors": self.decode_errors,
             "duplicate_frames": self.duplicate_frames,
@@ -567,7 +766,92 @@ class FederationReceiver:
             "samples_merged": self.samples_merged,
             "samples_shed": self.samples_shed,
             "samples_parked": self.samples_parked,
+            "freshness_samples": self.fleet_freshness.count,
+            "freshness_pending": pending,
+            "freshness_dropped": self.freshness_dropped,
             "emitters": per_emitter,
+        }
+
+    def fleet_report(self, top_k: int = 3) -> dict:
+        """The ``/fleetz`` payload: every emitter's rollup (sequencing,
+        lag, freshness p99, clock skew, piggybacked health), top-K
+        slowest / laggiest / flappiest lists, and starvation / skew flag
+        lists.  Percentiles run through the jax-free mirror so a bare
+        receiver can serve this without device code."""
+        now_ns = time.monotonic_ns()
+        now = time.monotonic()
+        with self._lock:
+            snap = list(self.emitters.items())
+            rows = {}
+            for eid, s in snap:
+                health = s.health or {}
+                p99s = health.get("p99_us", {})
+                lag = self._lag_locked(s, now_ns)
+                rows[f"{eid:016x}"] = {
+                    "last_seq": s.last_seq,
+                    "frames": s.frames,
+                    "samples": s.samples,
+                    "gaps": s.gaps,
+                    "duplicates": s.duplicates,
+                    "parked_rows": s.parked_rows,
+                    "wire_v": s.wire_v,
+                    "lag_s": round(lag, 3),
+                    "skew_s": round(s.skew_ns / 1e9, 6),
+                    "stalled": lag > self.starvation_s,
+                    "freshness_p99_us": round(
+                        s.freshness.percentile_host(99.0), 1
+                    ),
+                    "stage_p99_us": p99s,
+                    "backlog": health.get("backlog", 0),
+                    "send_failures": health.get("fail", 0),
+                    "restarts": health.get("restarts", 0),
+                    "uptime_s": health.get("up_s", 0.0),
+                    "health_age_s": (
+                        round(now - s.health_t, 1) if s.health else None
+                    ),
+                }
+            pending = len(self._pending)
+        def _top(key) -> list:
+            ranked = sorted(
+                rows.items(), key=lambda kv: key(kv[1]), reverse=True
+            )
+            return [eid for eid, r in ranked[:top_k] if key(r) > 0]
+        return {
+            "emitters": rows,
+            "fleet": {
+                "emitters": len(rows),
+                "expected_emitters": self.expected_emitters,
+                "freshness_p99_us": round(
+                    self.fleet_freshness.percentile_host(99.0), 1
+                ),
+                "freshness_samples": self.fleet_freshness.count,
+                "freshness_pending": pending,
+                "oldest_pending_age_s": round(
+                    self.oldest_pending_age_s(), 3
+                ),
+                "frames_received": self.frames_received,
+                "seq_gaps": self.seq_gaps,
+                "samples_merged": self.samples_merged,
+                "samples_shed": self.samples_shed,
+            },
+            "top": {
+                "slowest": _top(
+                    lambda r: max(r["stage_p99_us"].values(), default=0.0)
+                ),
+                "laggiest": _top(lambda r: r["lag_s"]),
+                "flappiest": _top(
+                    lambda r: r["restarts"] * 1000 + r["send_failures"]
+                ),
+            },
+            "flags": {
+                "starved": [
+                    eid for eid, r in rows.items() if r["stalled"]
+                ],
+                "clock_skew": [
+                    eid for eid, r in rows.items()
+                    if abs(r["skew_s"]) > self.skew_tolerance_s
+                ],
+            },
         }
 
     def register_gauges(self, ms) -> None:
@@ -620,17 +904,38 @@ class FederationReceiver:
         ms.register_gauge_func(
             "federation.MaxEmitterLagS", self.max_emitter_lag_s,
         )
+        ms.register_gauge_func(
+            "federation.MaxEmitterSkewS", self.max_emitter_skew_s,
+        )
+        ms.register_gauge_func(
+            "fed.freshness_p99_us",
+            lambda: self.fleet_freshness.percentile_host(99.0),
+        )
+        ms.register_gauge_func(
+            "fed.freshness_pending",
+            lambda: float(len(self._pending)),
+        )
 
     def _register_emitter_gauge(self, emitter_id: int) -> None:
         ms = getattr(self, "_ms", None)
         if ms is None:
             return
         def _lag(eid=emitter_id) -> float:
+            now_ns = time.monotonic_ns()
             with self._lock:
                 s = self.emitters.get(eid)
                 if s is None:
                     return 0.0
-                return time.monotonic() - s.last_frame_t
+                return self._lag_locked(s, now_ns)
         ms.register_gauge_func(
             f"federation.emitter.{emitter_id:016x}.LagS", _lag
+        )
+        def _fresh_p99(eid=emitter_id) -> float:
+            with self._lock:
+                s = self.emitters.get(eid)
+            if s is None:
+                return 0.0
+            return s.freshness.percentile_host(99.0)
+        ms.register_gauge_func(
+            f"fed.emitter.{emitter_id:016x}.freshness_p99_us", _fresh_p99
         )

@@ -25,21 +25,45 @@ owns the DELTA payload bytes.  Decode is strict: every declared length
 must land exactly on the payload end, and any violation raises
 ``WireError`` — which the receiver counts as a decode error and refuses
 to apply, because a mis-split triple array would merge garbage counts.
+
+Wire v2 (``KIND_DELTA2``) prepends observability fields to the same
+body so the fleet plane can trace and time frames across the process
+boundary:
+
+    <u64 emitter_id> <u64 seq>
+    <u64 mono_ns> <u64 wall_ns>          capture stamps (emitter clocks)
+    <u32 health_len> health_len B json   compact emitter health summary
+    <u32 n_names> <u32 n_rows> ...       v1 body, unchanged
+
+``mono_ns``/``wall_ns`` are the emitter's CLOCK_MONOTONIC and wall
+clock at the moment the interval's first sample was staged (flush time
+for empty heartbeats).  Monotonic stamps are only comparable to other
+stamps from the same process; the receiver anchors them per emitter and
+works in deltas, using the wall stamp purely as a merge-alignment
+anchor and clock-skew detector.  The payload version rides on the frame
+*kind* — never on ops.codec's FRAME_VERSION, which old decoders reject
+outright — so a v1 receiver skips v2 frames as unknown kinds and a v2
+receiver still applies v1 frames (minus freshness/health).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
 import struct
+from typing import Optional
 
 import numpy as np
 
-# frame ``kind`` byte (ops.codec.encode_frame) for DELTA payloads
-KIND_DELTA = 1
+# frame ``kind`` bytes (ops.codec.encode_frame) for DELTA payloads
+KIND_DELTA = 1   # v1: id/seq + dictionary + rows
+KIND_DELTA2 = 2  # v2: v1 + capture stamps + health summary
 
 _DELTA_HEAD = struct.Struct("<QQII")
+_DELTA2_HEAD = struct.Struct("<QQQQI")  # emitter_id, seq, mono_ns, wall_ns, health_len
 _NAME_HEAD = struct.Struct("<IH")
 _MAX_NAME_BYTES = 4096
+_MAX_HEALTH_BYTES = 65536
 
 
 class WireError(ValueError):
@@ -53,22 +77,34 @@ class DeltaFrame:
     seq: int
     names: list  # [(local_id, name), ...] first shipped in this frame
     packed: np.ndarray  # int32 [n, 3] (local_id, codec_bucket, count)
+    # v2-only observability fields; None when decoded from a v1 frame.
+    mono_ns: Optional[int] = None  # emitter CLOCK_MONOTONIC at capture
+    wall_ns: Optional[int] = None  # emitter wall clock at capture
+    health: Optional[dict] = None  # compact emitter health summary
 
     @property
     def samples(self) -> int:
         return int(self.packed[:, 2].sum(dtype=np.int64))
 
 
-def encode_delta(
-    emitter_id: int, seq: int, names, packed: np.ndarray
-) -> bytes:
-    """Assemble one DELTA payload (see module docstring for the layout)."""
+def fed_flow_id(emitter_id: int, seq: int) -> int:
+    """Deterministic Perfetto flow id for one (emitter, interval) frame.
+
+    Both sides of the process boundary derive the same id from fields
+    already on the wire, so no extra bytes are spent on trace context.
+    Kept under 2^53 so the id survives a JSON round trip exactly.
+    """
+    return ((emitter_id & 0x1FFFFF) << 32) | (seq & 0xFFFFFFFF)
+
+
+def _encode_body(names, packed: np.ndarray) -> list:
+    """Shared v1/v2 tail: <u32 n_names> <u32 n_rows> dictionary rows."""
     packed = np.ascontiguousarray(packed, dtype=np.int32)
     if packed.ndim != 2 or packed.shape[1] != 3:
         raise ValueError(
             f"packed must be [n, 3] (id, bucket, count); got {packed.shape}"
         )
-    parts = [_DELTA_HEAD.pack(emitter_id, seq, len(names), len(packed))]
+    parts = [struct.pack("<II", len(names), len(packed))]
     for local_id, name in names:
         raw = name.encode("utf-8")
         if len(raw) > _MAX_NAME_BYTES:
@@ -81,19 +117,55 @@ def encode_delta(
     if not packed.dtype.isnative:
         packed = packed.astype("<i4")
     parts.append(packed.tobytes())
-    return b"".join(parts)
+    return parts
 
 
-def decode_delta(payload: bytes) -> DeltaFrame:
-    """Parse one DELTA payload; raises WireError on any structural
-    violation instead of returning a best guess."""
-    if len(payload) < _DELTA_HEAD.size:
+def encode_delta(
+    emitter_id: int, seq: int, names, packed: np.ndarray
+) -> bytes:
+    """Assemble one v1 DELTA payload (see module docstring)."""
+    body = _encode_body(names, packed)
+    return b"".join(
+        [struct.pack("<QQ", emitter_id, seq)] + body
+    )
+
+
+def encode_delta2(
+    emitter_id: int,
+    seq: int,
+    names,
+    packed: np.ndarray,
+    mono_ns: int,
+    wall_ns: int,
+    health: Optional[dict] = None,
+) -> bytes:
+    """Assemble one v2 DELTA payload: capture stamps + health + v1 body."""
+    raw_health = b""
+    if health:
+        raw_health = json.dumps(
+            health, separators=(",", ":"), sort_keys=True
+        ).encode("utf-8")
+        if len(raw_health) > _MAX_HEALTH_BYTES:
+            raise ValueError(
+                f"health summary is {len(raw_health)} B "
+                f"(cap {_MAX_HEALTH_BYTES})"
+            )
+    head = _DELTA2_HEAD.pack(
+        emitter_id, seq, int(mono_ns), int(wall_ns), len(raw_health)
+    )
+    body = _encode_body(names, packed)
+    return b"".join([head, raw_health] + body)
+
+
+def _decode_body(payload: bytes, off: int):
+    """Parse <u32 n_names> <u32 n_rows> dictionary rows from ``off`` to
+    exactly the payload end; returns (names, packed)."""
+    if off + 8 > len(payload):
         raise WireError(
-            f"DELTA payload {len(payload)} B is shorter than its "
-            f"{_DELTA_HEAD.size} B header"
+            f"DELTA payload {len(payload)} B is shorter than its header"
         )
-    emitter_id, seq, n_names, n_rows = _DELTA_HEAD.unpack_from(payload, 0)
-    off = _DELTA_HEAD.size
+    n_names, n_rows = struct.unpack_from("<II", payload, off)
+    off += 8
     names = []
     for _ in range(n_names):
         if off + _NAME_HEAD.size > len(payload):
@@ -119,6 +191,65 @@ def decode_delta(payload: bytes) -> DeltaFrame:
         .reshape(n_rows, 3)
         .astype(np.int32)  # native, writable copy: the receiver rewrites
     )                      # the id column in place
+    return names, packed
+
+
+def decode_delta(payload: bytes) -> DeltaFrame:
+    """Parse one v1 DELTA payload; raises WireError on any structural
+    violation instead of returning a best guess."""
+    if len(payload) < _DELTA_HEAD.size:
+        raise WireError(
+            f"DELTA payload {len(payload)} B is shorter than its "
+            f"{_DELTA_HEAD.size} B header"
+        )
+    emitter_id, seq = struct.unpack_from("<QQ", payload, 0)
+    names, packed = _decode_body(payload, 16)
     return DeltaFrame(
         emitter_id=emitter_id, seq=seq, names=names, packed=packed
     )
+
+
+def decode_delta2(payload: bytes) -> DeltaFrame:
+    """Parse one v2 DELTA payload (stamps + health + v1 body)."""
+    if len(payload) < _DELTA2_HEAD.size:
+        raise WireError(
+            f"DELTA2 payload {len(payload)} B is shorter than its "
+            f"{_DELTA2_HEAD.size} B header"
+        )
+    emitter_id, seq, mono_ns, wall_ns, health_len = _DELTA2_HEAD.unpack_from(
+        payload, 0
+    )
+    off = _DELTA2_HEAD.size
+    if health_len > _MAX_HEALTH_BYTES or off + health_len > len(payload):
+        raise WireError(
+            f"DELTA2 health blob of {health_len} B overruns the payload"
+        )
+    health = None
+    if health_len:
+        try:
+            health = json.loads(payload[off:off + health_len])
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise WireError(f"DELTA2 health blob is not json: {e}") from e
+        if not isinstance(health, dict):
+            raise WireError("DELTA2 health blob must be a json object")
+    off += health_len
+    names, packed = _decode_body(payload, off)
+    return DeltaFrame(
+        emitter_id=emitter_id,
+        seq=seq,
+        names=names,
+        packed=packed,
+        mono_ns=mono_ns,
+        wall_ns=wall_ns,
+        health=health,
+    )
+
+
+def decode_payload(kind: int, payload: bytes) -> DeltaFrame:
+    """Dispatch on the frame kind byte; raises WireError for kinds this
+    receiver does not speak (forward-compat: count and drop, don't crash)."""
+    if kind == KIND_DELTA:
+        return decode_delta(payload)
+    if kind == KIND_DELTA2:
+        return decode_delta2(payload)
+    raise WireError(f"unknown DELTA frame kind {kind}")

@@ -41,6 +41,15 @@ Invariants evaluated (each yields a machine-readable reason dict
   * ``fed_decode_errors``    — a federation frame failed CRC/schema
     validation (or tore at connection EOF) recently; corrupt deltas are
     dropped, never merged (ISSUE 11; latched one stall window).
+  * ``fleet_freshness_stall`` — federation frames were applied but
+    their samples have not become queryable for more than the stall
+    window: the fan-in tier ingests while the commit path starves it
+    of publishes (ISSUE 12).
+  * ``emitter_clock_skew``   — an emitter's wall clock diverged from
+    its monotonic clock past the tolerance since its anchor (NTP step,
+    VM pause, or an injected ``clock_step``); per-emitter lag stays
+    correct (monotonic-only) but wall-aligned trace merges and
+    wall-stamped logs from that emitter are suspect (ISSUE 12).
 
 ``no_commit`` makes the report STALLED; every other reason makes it
 DEGRADED; otherwise OK.  Event-shaped invariants (fan-outs, evictions)
@@ -111,6 +120,7 @@ class HealthWatchdog:
         recovery=None,
         federation=None,
         federation_starvation_intervals: float = 3.0,
+        federation_skew_tolerance_s: float = 1.0,
     ):
         self._committer = committer
         self._agg = aggregator
@@ -126,6 +136,7 @@ class HealthWatchdog:
         self.federation_starvation_intervals = float(
             federation_starvation_intervals
         )
+        self.federation_skew_tolerance_s = float(federation_skew_tolerance_s)
         self.interval = float(interval)
         self.stall_intervals = float(stall_intervals)
         self.backpressure_fraction = float(backpressure_fraction)
@@ -344,6 +355,41 @@ class HealthWatchdog:
                     ),
                     "value": float(fed_errs),
                 })
+            # freshness stall: frames applied, nothing published since
+            pending_age = getattr(fed, "oldest_pending_age_s", None)
+            if pending_age is not None:
+                pend_s = float(pending_age())
+                if pend_s > self._latch_window:
+                    reasons.append({
+                        "code": "fleet_freshness_stall",
+                        "detail": (
+                            "federation frame(s) applied "
+                            f"{pend_s:.3f}s ago are still not "
+                            "queryable (> "
+                            f"{self.stall_intervals:g} x "
+                            f"{self.interval:g}s); the commit path is "
+                            "starving the fan-in tier of publishes"
+                        ),
+                        "value": pend_s,
+                    })
+            # clock skew: live state off the per-emitter anchors, not a
+            # latch — skew persists until the emitter re-anchors
+            skew_f = getattr(fed, "max_emitter_skew_s", None)
+            if skew_f is not None:
+                skew_s = float(skew_f())
+                if skew_s > self.federation_skew_tolerance_s:
+                    reasons.append({
+                        "code": "emitter_clock_skew",
+                        "detail": (
+                            "an emitter's wall clock diverged "
+                            f"{skew_s:.3f}s from its monotonic clock "
+                            "since anchor (> "
+                            f"{self.federation_skew_tolerance_s:g}s "
+                            "tolerance); its wall-stamped data is "
+                            "suspect"
+                        ),
+                        "value": skew_s,
+                    })
 
         down_until = float(getattr(agg, "_device_down_until", 0.0) or 0.0)
         if down_until > now:
@@ -391,7 +437,8 @@ class HealthWatchdog:
                      "subscriber_evictions", "device_cooldown",
                      "thread_restarted", "breaker_open",
                      "recovery_in_progress", "emitter_starvation",
-                     "fed_decode_errors"):
+                     "fed_decode_errors", "fleet_freshness_stall",
+                     "emitter_clock_skew"):
             ms.register_gauge_func(
                 f"health.{code}",
                 lambda c=code: float(c in self.report().reason_codes()),

@@ -40,19 +40,23 @@ from typing import Dict, NamedTuple, Optional, Tuple
 import numpy as np
 
 from loghisto_tpu.config import PRECISION
-from loghisto_tpu.ops.codec import compress_np
+from loghisto_tpu.ops.codec import compress_np, decompress_np
 
 
 class Span(NamedTuple):
     """One closed span: a named pipeline stage, its wall-clock bounds
     (``perf_counter_ns``), the interval it attributes to, and the
-    recording thread's name (the Perfetto track)."""
+    recording thread's name (the Perfetto track).  ``flow`` is an
+    optional cross-process flow id (``wire.fed_flow_id``): spans that
+    carry one are chained across emitter/receiver trace dumps by
+    ``perfetto.merge_traces``."""
 
     stage: str
     start_ns: int
     end_ns: int
     seq: int
     thread: str
+    flow: Optional[int] = None
 
     @property
     def duration_us(self) -> float:
@@ -84,12 +88,14 @@ class _SpanHandle:
     stages tolerate one small allocation; the O(ns) claim is about
     ``record()`` itself, which tests pin against a time budget."""
 
-    __slots__ = ("_rec", "stage", "seq", "start_ns")
+    __slots__ = ("_rec", "stage", "seq", "flow", "start_ns")
 
-    def __init__(self, rec: "SpanRecorder", stage: str, seq: Optional[int]):
+    def __init__(self, rec: "SpanRecorder", stage: str, seq: Optional[int],
+                 flow: Optional[int] = None):
         self._rec = rec
         self.stage = stage
         self.seq = seq
+        self.flow = flow
 
     def __enter__(self) -> "_SpanHandle":
         self.start_ns = time.perf_counter_ns()
@@ -97,7 +103,8 @@ class _SpanHandle:
 
     def __exit__(self, *exc) -> None:
         self._rec.record(
-            self.stage, self.start_ns, time.perf_counter_ns(), self.seq
+            self.stage, self.start_ns, time.perf_counter_ns(), self.seq,
+            self.flow,
         )
 
 
@@ -154,6 +161,7 @@ class SpanRecorder:
         start_ns: int,
         end_ns: int,
         seq: Optional[int] = None,
+        flow: Optional[int] = None,
     ) -> None:
         """Store one closed span.  ~O(ns): one atomic counter increment,
         one tuple build, one masked slot store.  Drop-oldest by
@@ -165,13 +173,15 @@ class SpanRecorder:
             stage, start_ns, end_ns,
             self.current_seq if seq is None else seq,
             threading.current_thread().name,
+            flow,
         )
 
-    def span(self, stage: str, seq: Optional[int] = None):
+    def span(self, stage: str, seq: Optional[int] = None,
+             flow: Optional[int] = None):
         """Context manager that records ``stage`` on exit."""
         if not self.enabled:
             return _NULL_HANDLE
-        return _SpanHandle(self, stage, seq)
+        return _SpanHandle(self, stage, seq, flow)
 
     # -- readers (best-effort, rendezvous-free) ------------------------- #
 
@@ -234,7 +244,8 @@ class _NullRecorder:
     def record(self, *a, **k) -> None:
         pass
 
-    def span(self, stage: str, seq: Optional[int] = None):
+    def span(self, stage: str, seq: Optional[int] = None,
+             flow: Optional[int] = None):
         return _NULL_HANDLE
 
     def spans(self) -> Tuple[Span, ...]:
@@ -248,6 +259,30 @@ class _NullRecorder:
 
 
 NULL_RECORDER = _NullRecorder()
+
+
+def percentile_sparse_host(
+    buckets, counts, ps, precision: int = PRECISION
+) -> np.ndarray:
+    """Jax-free mirror of ``ops.stats.percentiles_sparse``.
+
+    Byte-for-byte the same selection rule (stable argsort, uint64
+    cumsum, ``float64(cum)/float64(total) >= p`` via a left-side
+    searchsorted), but importable from processes that must never load
+    jax — federation emitters compute their own stage p99s with this.
+    Keep in lockstep with ops/stats.py; tests pin the two equal.
+    """
+    buckets = np.asarray(buckets)
+    if len(buckets) == 0:
+        return np.zeros(len(np.asarray(ps)))
+    order = np.argsort(buckets, kind="stable")
+    values = decompress_np(buckets[order], precision)
+    cdf = np.cumsum(np.asarray(counts, dtype=np.uint64)[order])
+    total = float(cdf[-1])
+    cdfn = cdf.astype(np.float64) / total
+    idx = np.searchsorted(cdfn, np.asarray(ps, dtype=np.float64), side="left")
+    idx = np.minimum(idx, len(values) - 1)
+    return values[idx]
 
 
 class LatencyHistogram:
@@ -286,6 +321,37 @@ class LatencyHistogram:
         return float(percentiles_sparse(
             buckets, counts, np.asarray([q / 100.0]), self.precision
         )[0])
+
+    def percentile_host(self, q: float) -> float:
+        """Same selection rule as ``percentile`` but via the jax-free
+        mirror — safe to call from federation emitter processes."""
+        with self._lock:
+            if not self._buckets:
+                return 0.0
+            buckets = np.fromiter(self._buckets.keys(), dtype=np.int64)
+            counts = np.fromiter(self._buckets.values(), dtype=np.int64)
+        return float(percentile_sparse_host(
+            buckets, counts, np.asarray([q / 100.0]), self.precision
+        )[0])
+
+    def count_above(self, value_us: float) -> int:
+        """Samples whose bucket lies strictly above ``value_us``'s
+        bucket — the numerator of an SLO "fraction over budget"."""
+        b = int(compress_np(np.asarray([value_us]), self.precision)[0])
+        with self._lock:
+            return sum(c for k, c in self._buckets.items() if k > b)
+
+    def snapshot(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(buckets, counts) copy for host-side oracles and rollups."""
+        with self._lock:
+            buckets = np.fromiter(
+                self._buckets.keys(), dtype=np.int64, count=len(self._buckets)
+            )
+            counts = np.fromiter(
+                self._buckets.values(), dtype=np.int64,
+                count=len(self._buckets),
+            )
+        return buckets, counts
 
 
 class SelfObserver:

@@ -209,6 +209,9 @@ class PrometheusEndpoint:
                 if path == "/healthz":
                     self._serve_healthz()
                     return
+                if path == "/fleetz":
+                    self._serve_fleetz()
+                    return
                 if path not in ("", "/metrics"):
                     self.send_error(404)
                     return
@@ -252,6 +255,26 @@ class PrometheusEndpoint:
                     status = 503 if report.status == "stalled" else 200
                 payload = json.dumps(doc).encode()
                 self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _serve_fleetz(self):
+                """Fleet health rollup (ISSUE 12): the federation
+                receiver's per-emitter report as JSON — top-K slowest /
+                laggiest / flappiest emitters, starvation and clock-skew
+                flags — beside /healthz's single-process view.  404 when
+                the system has no federation tier."""
+                import json
+
+                fed = getattr(endpoint._ms, "federation", None)
+                if fed is None or not hasattr(fed, "fleet_report"):
+                    self.send_error(404, "no federation tier")
+                    return
+                doc = fed.fleet_report()
+                payload = json.dumps(doc).encode()
+                self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()

@@ -382,6 +382,24 @@ class TPUMetricSystem(MetricSystem):
                 fault_injector=self.fault_injector,
             )
             self.federation.register_gauges(self)
+            # fleet observability (ISSUE 12): freshness/rollup knobs
+            self.federation.starvation_s = (
+                fcfg.starvation_intervals * self.interval
+            )
+            self.federation.skew_tolerance_s = fcfg.skew_tolerance_s
+            # record→queryable freshness completes at snapshot publish
+            # when a commit path exists; headless/fanout systems fall
+            # back to the wheel's interval hook; otherwise samples
+            # complete at frame-apply time (has_publisher stays False)
+            if self.committer is not None:
+                self.committer.freshness_hook = self.federation.note_publish
+                self.federation.has_publisher = True
+            elif self.retention is not None:
+                fed = self.federation
+                self.retention.add_interval_hook(
+                    lambda raw: fed.note_publish(getattr(raw, "seq", None))
+                )
+                self.federation.has_publisher = True
 
         # -- self-observability (ISSUE 9) ------------------------------- #
         self.obs = None            # the SpanRecorder (None when off)
@@ -403,6 +421,11 @@ class TPUMetricSystem(MetricSystem):
             self.obs_config = cfg
             rec = SpanRecorder(cfg.capacity)
             self.obs = rec
+            # ring saturation signal (ISSUE 12): dropped > 0 means the
+            # ring wrapped faster than exporters drained it
+            self.register_gauge_func(
+                "obs.SpansDropped", lambda: float(rec.dropped)
+            )
             # hand the ring to every instrumentation site
             self.obs_recorder = rec          # reaper broadcast span
             self.aggregator.obs_recorder = rec
@@ -435,6 +458,10 @@ class TPUMetricSystem(MetricSystem):
                     federation_starvation_intervals=(
                         self.federation_config.starvation_intervals
                         if self.federation_config is not None else 3.0
+                    ),
+                    federation_skew_tolerance_s=(
+                        self.federation_config.skew_tolerance_s
+                        if self.federation_config is not None else 1.0
                     ),
                 )
                 if self.committer is not None:
@@ -495,6 +522,10 @@ class TPUMetricSystem(MetricSystem):
             "recorded": self.obs.recorded if self.obs else 0,
             "dropped": self.obs.dropped if self.obs else 0,
             "current_seq": self.obs.current_seq if self.obs else 0,
+            "saturated": (
+                bool(self.obs.recorded >= self.obs.capacity)
+                if self.obs else False
+            ),
         }
         if self.resilience is not None:
             dump["resilience"] = {
@@ -597,6 +628,14 @@ class TPUMetricSystem(MetricSystem):
                     "anomaly=AnomalyConfig(...))"
                 )
             rule.bind(self.anomaly)
+        elif getattr(rule, "kind", None) == "freshness":
+            if self.federation is None:
+                raise ValueError(
+                    "freshness rules read the federation receiver's "
+                    "end-to-end latency ledger: construct with "
+                    "TPUMetricSystem(federation=FederationConfig(...))"
+                )
+            rule.bind(self.federation)
         self.rule_engine.add(rule)
         self.rule_engine.register_gauges(self)
         return rule

@@ -21,6 +21,17 @@ single-process oracle.
 Usage: python federation_emitter_worker.py <port> <idx> <n_phases>
 Prints "EMITTER <idx> PHASE <p> SENT" per phase and
 "EMITTER <idx> OK <samples_shipped>" on success.
+
+Fleet-observability modes (ISSUE 12), both env-driven so the argv
+contract stays frozen:
+
+  LOGHISTO_FED_TRACE=<path>  dump this emitter's span ring as a
+    Perfetto JSON trace to <path> before exit, for the parent's
+    ``merge_traces`` cross-process flow-continuity check.
+  LOGHISTO_FED_WEDGE=1  go silent after phase 0: the emitter keeps its
+    TCP connection state but records/ships nothing further — the shape
+    of a wedged frontend that /fleetz must name (still syncs phases on
+    stdin and still prints OK, so the parent harness is unchanged).
 """
 
 import os
@@ -70,17 +81,29 @@ def main() -> int:
     lids = np.array(
         [e.local_id(n) for n in phase_names(idx)], dtype=np.int32
     )
+    wedge = os.environ.get("LOGHISTO_FED_WEDGE") == "1"
     for phase in range(n_phases):
-        k, values = phase_samples(idx, phase)
-        e.record_batch(lids[k], values)
-        e.flush()
-        if not e.drain(60.0):
-            print(f"EMITTER {idx} DRAIN-FAIL", flush=True)
-            return 1
-        print(f"EMITTER {idx} PHASE {phase} SENT", flush=True)
+        if wedge and phase > 0:
+            # wedged frontend: alive but silent — no records, no
+            # flushes, no heartbeats (the ticker is stopped too)
+            e._stop.set()
+            print(f"EMITTER {idx} PHASE {phase} SENT", flush=True)
+        else:
+            k, values = phase_samples(idx, phase)
+            e.record_batch(lids[k], values)
+            e.flush()
+            if not e.drain(60.0):
+                print(f"EMITTER {idx} DRAIN-FAIL", flush=True)
+                return 1
+            print(f"EMITTER {idx} PHASE {phase} SENT", flush=True)
         if phase + 1 < n_phases:
             if not sys.stdin.readline():  # parent died
                 return 1
+    trace_path = os.environ.get("LOGHISTO_FED_TRACE")
+    if trace_path:
+        from loghisto_tpu.obs.perfetto import dump_perfetto
+
+        dump_perfetto(e.obs, trace_path, process_name=f"emitter-{idx}")
     ok = e.close(drain_timeout=60.0)
     assert "jax" not in sys.modules, "emitter process imported jax"
     print(f"EMITTER {idx} OK {e.samples_shipped}", flush=True)

@@ -121,6 +121,24 @@ def test_frame_fuzz_every_bit_flip_fails_closed():
                 decode_frame(bytes(bad))
 
 
+def test_v1_frames_from_old_emitters_still_apply(rx):
+    """Backward compat (ISSUE 12): a wire_version=1 emitter's frames
+    keep applying through the v2 receiver — minus freshness/health —
+    and the mixed-fleet stats mark them."""
+    e = FederationEmitter(("127.0.0.1", rx.port), interval=0.2,
+                          emitter_id=46, wire_version=1)
+    e.record("fed.v1.lat", 1.0)
+    e.flush()
+    e._sender.start_sender("v1-compat")
+    assert e.drain(10.0)
+    _wait(lambda: rx.samples_merged == 1, what="v1 emitter merge")
+    st = rx.stats()
+    assert st["frames_v1"] == 1
+    assert st["emitters"][f"{46:016x}"]["wire_v"] == 1
+    assert st["freshness_samples"] == 0
+    e.close(drain_timeout=1.0)
+
+
 def test_delta_payload_structural_violations_raise_wireerror():
     good = wire.encode_delta(
         1, 1, [(0, "m")], np.array([[0, 0, 1]], dtype=np.int32)

@@ -1,0 +1,679 @@
+"""Fleet observability plane (ISSUE 12): wire-v2 stamps/health, cross-
+process flow-id propagation, merged Perfetto traces, end-to-end
+freshness (record -> queryable) with a host-side bit-identity oracle,
+the freshness SLO-burn rule, the /fleetz health rollup, clock-skew
+guards, and the 32-emitter subprocess drill tying them all together.
+
+Wire drills run against the same StubAgg as test_federation.py; the
+oracle/system tests use the real stack.
+"""
+
+import json
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from loghisto_tpu.federation import FederationConfig, wire
+from loghisto_tpu.federation.emitter import FederationEmitter
+from loghisto_tpu.federation.receiver import FederationReceiver
+from loghisto_tpu.obs.perfetto import dump_perfetto, merge_traces
+from loghisto_tpu.obs.spans import (
+    LatencyHistogram, SpanRecorder, percentile_sparse_host,
+)
+from loghisto_tpu.ops.codec import compress_np, encode_frame
+
+from federation_emitter_worker import (  # tests/ is on sys.path (rootdir)
+    CFG,
+    SAMPLES_PER_PHASE,
+)
+from test_federation import StubAgg, _wait
+
+pytestmark = [pytest.mark.federation, pytest.mark.fleet_obs]
+
+REPO_WORKER = __file__.replace(
+    "test_fleet_obs.py", "federation_emitter_worker.py"
+)
+
+
+def _send_raw(port, data):
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+        s.sendall(data)
+
+
+def _v2_payload(emitter_id=7, seq=1, mono_ns=None, wall_ns=None,
+                health=None, names=((0, "m.a"),), rows=((0, 10, 3),)):
+    return wire.encode_delta2(
+        emitter_id, seq, list(names),
+        np.array(rows, dtype=np.int32).reshape(-1, 3),
+        time.monotonic_ns() if mono_ns is None else mono_ns,
+        time.time_ns() if wall_ns is None else wall_ns,
+        health,
+    )
+
+
+# -- wire v2 codec ------------------------------------------------------- #
+
+
+def test_wire_v2_roundtrip_carries_stamps_and_health():
+    health = {"p99_us": {"fold": 12.5}, "backlog": 2, "fail": 0,
+              "restarts": 1, "up_s": 3.5, "frames": 9, "samples": 400}
+    payload = _v2_payload(
+        emitter_id=0xDEAD, seq=17, mono_ns=123456789, wall_ns=987654321,
+        health=health, names=((0, "m.a"), (1, "m.b")),
+        rows=((0, 10, 3), (1, -4, 2)),
+    )
+    d = wire.decode_payload(wire.KIND_DELTA2, payload)
+    assert (d.emitter_id, d.seq) == (0xDEAD, 17)
+    assert (d.mono_ns, d.wall_ns) == (123456789, 987654321)
+    assert d.health == health
+    assert d.names == [(0, "m.a"), (1, "m.b")]
+    assert d.samples == 5
+
+
+def test_wire_v2_empty_health_decodes_as_none():
+    d = wire.decode_delta2(_v2_payload(health=None))
+    assert d.health is None
+    assert d.mono_ns is not None
+
+
+def test_wire_v2_truncation_fuzz_every_cut_raises():
+    payload = _v2_payload(health={"backlog": 1}, names=((0, "m.a"),))
+    for cut in range(len(payload)):
+        with pytest.raises(wire.WireError):
+            wire.decode_delta2(payload[:cut])
+    with pytest.raises(wire.WireError):
+        wire.decode_delta2(payload + b"\x00")  # trailing garbage
+
+
+def test_wire_v1_decode_fuzz_through_dispatcher():
+    """Backward compat: the v2 receiver's dispatcher must decode every
+    valid v1 payload and fail closed on every truncation of one."""
+    payload = wire.encode_delta(
+        3, 5, [(0, "m.v1")], np.array([[0, 7, 2]], dtype=np.int32)
+    )
+    d = wire.decode_payload(wire.KIND_DELTA, payload)
+    assert d.mono_ns is None and d.wall_ns is None and d.health is None
+    assert d.samples == 2
+    for cut in range(len(payload)):
+        with pytest.raises(wire.WireError):
+            wire.decode_payload(wire.KIND_DELTA, payload[:cut])
+    with pytest.raises(wire.WireError):
+        wire.decode_payload(99, payload)  # unknown kind fails closed
+
+
+def test_fed_flow_id_deterministic_and_json_safe():
+    assert wire.fed_flow_id(7, 1) == wire.fed_flow_id(7, 1)
+    assert wire.fed_flow_id(7, 1) != wire.fed_flow_id(7, 2)
+    assert wire.fed_flow_id(7, 1) != wire.fed_flow_id(8, 1)
+    for eid, seq in ((2**64 - 1, 2**32 - 1), (0, 1), (123456, 999)):
+        fid = wire.fed_flow_id(eid, seq)
+        assert 0 <= fid < 2**53  # survives a JSON round trip exactly
+        assert json.loads(json.dumps({"id": fid}))["id"] == fid
+
+
+# -- jax-free percentile mirror ------------------------------------------ #
+
+
+def test_percentile_host_bit_identical_to_jax_path():
+    from loghisto_tpu.ops.stats import percentiles_sparse
+
+    rng = np.random.default_rng(7)
+    values = rng.uniform(0.5, 5e6, size=4096)
+    hist = LatencyHistogram()
+    for v in values:
+        hist.add(float(v))
+    buckets, counts = hist.snapshot()
+    ps = np.array([0.5, 0.9, 0.99, 0.999])
+    mirror = percentile_sparse_host(buckets, counts, ps)
+    oracle = np.asarray(percentiles_sparse(buckets, counts, ps))
+    assert np.array_equal(mirror, oracle)
+    for q in (50.0, 99.0, 99.9):
+        assert hist.percentile_host(q) == hist.percentile(q)
+
+
+# -- receiver: v1 interop, freshness, publish hook ----------------------- #
+
+
+@pytest.fixture
+def rx():
+    agg = StubAgg()
+    r = FederationReceiver(agg)
+    r.start()
+    yield r
+    r.stop()
+
+
+def test_v1_frame_applies_without_freshness(rx):
+    payload = wire.encode_delta(
+        11, 1, [(0, "m.v1")], np.array([[0, 3, 4]], dtype=np.int32)
+    )
+    _send_raw(rx.port, encode_frame(wire.KIND_DELTA, payload))
+    _wait(lambda: rx.frames_received == 1, what="v1 frame apply")
+    st = rx.stats()
+    assert st["frames_v1"] == 1
+    assert st["freshness_samples"] == 0  # no stamps, no latency sample
+    assert st["emitters"][f"{11:016x}"]["wire_v"] == 1
+    assert rx.aggregator.merged_samples() == 4
+
+
+def test_v2_frame_completes_freshness_at_apply_without_publisher(rx):
+    _send_raw(rx.port, encode_frame(wire.KIND_DELTA2, _v2_payload(seq=1)))
+    _wait(lambda: rx.stats()["freshness_samples"] == 1, what="freshness")
+    st = rx.stats()
+    assert st["freshness_pending"] == 0
+    assert rx.fleet_freshness.count == 1
+    assert len(rx.freshness_values) == 1
+    assert rx.freshness_values[0] >= 0.0
+
+
+def test_publisher_mode_pends_until_note_publish(rx):
+    rx.has_publisher = True
+    _send_raw(rx.port, encode_frame(wire.KIND_DELTA2, _v2_payload(seq=1)))
+    _wait(lambda: rx.stats()["freshness_pending"] == 1, what="pending")
+    assert rx.stats()["freshness_samples"] == 0
+    assert rx.oldest_pending_age_s() >= 0.0
+    assert rx.note_publish(1) == 1  # the commit hook fires
+    st = rx.stats()
+    assert st["freshness_pending"] == 0 and st["freshness_samples"] == 1
+
+
+def test_health_summary_piggybacks_into_fleet_report(rx):
+    health = {"p99_us": {"fold": 42.0, "encode": 7.0}, "backlog": 3,
+              "fail": 1, "restarts": 2, "up_s": 60.0}
+    _send_raw(rx.port, encode_frame(
+        wire.KIND_DELTA2, _v2_payload(seq=1, health=health)))
+    _wait(lambda: rx.frames_received == 1, what="frame")
+    rep = rx.fleet_report()
+    row = rep["emitters"][f"{7:016x}"]
+    assert row["stage_p99_us"] == health["p99_us"]
+    assert row["backlog"] == 3 and row["send_failures"] == 1
+    assert row["restarts"] == 2 and row["uptime_s"] == 60.0
+    assert f"{7:016x}" in rep["top"]["slowest"]
+    assert f"{7:016x}" in rep["top"]["flappiest"]
+
+
+def test_fleet_report_names_starved_emitter(rx):
+    rx.starvation_s = 0.2
+    _send_raw(rx.port, encode_frame(
+        wire.KIND_DELTA2, _v2_payload(emitter_id=1, seq=1)))
+    _send_raw(rx.port, encode_frame(
+        wire.KIND_DELTA2, _v2_payload(emitter_id=2, seq=1,
+                                      names=((0, "m.b"),))))
+    _wait(lambda: rx.frames_received == 2, what="both emitters")
+    time.sleep(0.35)  # emitter 2 goes silent; emitter 1 keeps flushing
+    _send_raw(rx.port, encode_frame(
+        wire.KIND_DELTA2, _v2_payload(emitter_id=1, seq=2, names=())))
+    _wait(lambda: rx.frames_received == 3, what="keepalive")
+    rep = rx.fleet_report()
+    assert f"{2:016x}" in rep["flags"]["starved"]
+    assert f"{1:016x}" not in rep["flags"]["starved"]
+    assert rep["emitters"][f"{2:016x}"]["stalled"]
+
+
+# -- clock-skew guard ----------------------------------------------------- #
+
+
+def test_clock_step_keeps_lag_nonnegative_and_flags_skew(rx):
+    from loghisto_tpu.resilience import FaultInjector
+
+    # step the emitter's wall clock back a minute on its SECOND flush:
+    # the first (un-stepped) frame anchors the clock pair
+    inj = FaultInjector().plan(
+        "fed.flush", "clock_step", on_call=2, step_s=-60.0
+    )
+    e = FederationEmitter(("127.0.0.1", rx.port), interval=0.2,
+                          emitter_id=77, fault_injector=inj)
+    e._sender.start_sender("clock-step")
+    e.record("fed.lat", 1.0)
+    e.flush()  # anchor frame
+    _wait(lambda: rx.frames_received == 1, what="anchor frame")
+    e.flush()  # stepped heartbeat: wall jumps back, monotonic does not
+    _wait(lambda: rx.frames_received == 2, what="stepped frame")
+    st = rx.stats()["emitters"][f"{77:016x}"]
+    # lag runs on monotonic deltas only: the backward wall step must
+    # not drive it negative (or huge)
+    assert 0.0 <= st["lag_s"] < 5.0
+    assert rx.max_emitter_lag_s() >= 0.0
+    # ... but the skew detector sees the full minute
+    assert st["skew_s"] < -50.0
+    assert rx.max_emitter_skew_s() > 50.0
+    rep = rx.fleet_report()
+    assert f"{77:016x}" in rep["flags"]["clock_skew"]
+    e.close(drain_timeout=1.0)
+
+
+def test_emitter_clock_skew_and_freshness_stall_invariants(rx):
+    from loghisto_tpu.obs.health import HealthWatchdog
+
+    class _Com:
+        fanout_intervals = 0
+        bridge_evictions = 0
+        intervals_committed = 0
+
+    class _Agg:
+        max_pending_samples = 0
+        pending_samples = 0
+        _xfer_queued_samples = 0
+        _device_down_until = 0.0
+
+    wd = HealthWatchdog(_Com(), _Agg(), interval=0.1,
+                        commit_path="fused", federation=rx,
+                        federation_skew_tolerance_s=1.0)
+    wd.note_commit(1)
+    assert "emitter_clock_skew" not in wd.report().reason_codes()
+    assert "fleet_freshness_stall" not in wd.report().reason_codes()
+
+    # skew: anchor an emitter, then deliver a frame whose wall clock
+    # ran 30s ahead of its monotonic clock
+    mono0, wall0 = time.monotonic_ns(), time.time_ns()
+    _send_raw(rx.port, encode_frame(wire.KIND_DELTA2, _v2_payload(
+        seq=1, mono_ns=mono0, wall_ns=wall0)))
+    _wait(lambda: rx.frames_received == 1, what="anchor")
+    _send_raw(rx.port, encode_frame(wire.KIND_DELTA2, _v2_payload(
+        seq=2, names=(), mono_ns=mono0 + 10**9,
+        wall_ns=wall0 + 31 * 10**9)))
+    _wait(lambda: rx.frames_received == 2, what="skewed frame")
+    wd.note_commit(2)
+    assert "emitter_clock_skew" in wd.report().reason_codes()
+
+    # freshness stall: an applied frame never published
+    rx.has_publisher = True
+    _send_raw(rx.port, encode_frame(wire.KIND_DELTA2, _v2_payload(
+        seq=3, names=())))
+    _wait(lambda: rx.stats()["freshness_pending"] == 1, what="pending")
+    with rx._lock:  # age the pending entry past the stall window
+        rx._pending = [
+            (eid, t - 10**12, b) for eid, t, b in rx._pending
+        ]
+    wd.note_commit(3)
+    assert "fleet_freshness_stall" in wd.report().reason_codes()
+    rx.note_publish()
+    wd.note_commit(4)
+    assert "fleet_freshness_stall" not in wd.report().reason_codes()
+
+
+# -- cross-process trace propagation -------------------------------------- #
+
+
+def test_flow_id_continuity_across_tcp(rx):
+    rec = SpanRecorder(512)
+    rx.obs_recorder = rec
+    e = FederationEmitter(("127.0.0.1", rx.port), interval=0.2,
+                          emitter_id=99)
+    e._sender.start_sender("flow-test")
+    e.record("fed.lat", 3.0)
+    e.flush()
+    assert e.drain(10.0)
+    _wait(lambda: rx.frames_received == 1, what="frame apply")
+    flow = wire.fed_flow_id(99, 1)
+    em_stages = {s.stage for s in e.obs.spans() if s.flow == flow}
+    assert {"fed.fold", "fed.encode", "fed.flush"} <= em_stages
+    rx_stages = {s.stage for s in rec.spans() if s.flow == flow}
+    assert {"fed.decode", "fed.apply", "fed.merge"} <= rx_stages
+    e.close(drain_timeout=1.0)
+
+
+def test_merge_traces_two_process_schema(tmp_path):
+    flow = wire.fed_flow_id(5, 3)
+    em, rxr = SpanRecorder(64), SpanRecorder(64)
+    t0 = time.perf_counter_ns()
+    em.record("fed.flush", t0, t0 + 1000, 3, flow)
+    dump_perfetto(em, str(tmp_path / "em.json"), process_name="emitter")
+    time.sleep(0.01)  # receiver work happens later on the wall clock
+    t1 = time.perf_counter_ns()
+    rxr.record("fed.apply", t1, t1 + 500, None, flow)
+    dump_perfetto(rxr, str(tmp_path / "rx.json"), process_name="receiver")
+
+    doc = merge_traces(
+        [str(tmp_path / "em.json"), str(tmp_path / "rx.json")],
+        out_path=str(tmp_path / "merged.json"),
+    )
+    evs = doc["traceEvents"]
+    assert doc["otherData"]["merged_from"] == ["emitter", "receiver"]
+    assert {e["pid"] for e in evs} == {1, 2}
+    assert all(e["ts"] >= 0.0 for e in evs if "ts" in e)
+    fed = [e for e in evs if e.get("cat") == "fed" and e["id"] == flow]
+    assert [e["ph"] for e in sorted(fed, key=lambda e: e["ts"])] \
+        == ["s", "t"]  # exactly one start, re-threaded across pids
+    assert {e["pid"] for e in fed} == {1, 2}
+    xs = {e["name"]: e for e in evs if e.get("ph") == "X"}
+    assert xs["fed.flush"]["args"]["flow"] == flow
+    assert xs["fed.apply"]["args"]["flow"] == flow
+    # wall-anchored: the emitter's flush lands before the receiver's
+    # apply on the merged timeline even though their perf_counter
+    # timebases are unrelated
+    assert xs["fed.flush"]["ts"] < xs["fed.apply"]["ts"]
+    reload = json.load(open(tmp_path / "merged.json"))
+    assert len(reload["traceEvents"]) == len(evs)
+
+
+# -- freshness SLO-burn rule ---------------------------------------------- #
+
+
+def test_freshness_slo_rule_fires_and_resolves():
+    from loghisto_tpu.window.rules import FreshnessSloRule
+
+    class _Rx:
+        def __init__(self):
+            self.total, self.above = 0, 0
+
+        def freshness_totals(self, budget_us, emitter_id=None):
+            return self.total, self.above
+
+    stub = _Rx()
+    rule = FreshnessSloRule("fresh", budget_us=1000.0, objective=0.99,
+                            threshold=2.0, receiver=stub)
+    assert rule.observe(None) == (None, False)  # one snapshot: no data
+    stub.total, stub.above = 100, 50  # 50% over budget: burn = 50x
+    burn, breach = rule.observe(None)
+    assert breach and burn == pytest.approx(50.0)
+    # errors stop while clean traffic floods in: the trailing fraction
+    # dilutes under the threshold and the rule resolves
+    stub.total, stub.above = 10_000, 50
+    burn, breach = rule.observe(None)
+    assert not breach and burn == pytest.approx(0.5)
+    assert "fleet" in rule.describe()
+    assert rule.device_windows() == ()
+
+
+def test_freshness_rule_validation_and_binding():
+    from loghisto_tpu.window.rules import FreshnessSloRule
+
+    with pytest.raises(ValueError):
+        FreshnessSloRule("r", budget_us=0.0)
+    with pytest.raises(ValueError):
+        FreshnessSloRule("r", budget_us=1.0, objective=1.5)
+    with pytest.raises(ValueError):
+        FreshnessSloRule("r", budget_us=1.0, short_window=400.0)
+    rule = FreshnessSloRule("r", budget_us=1.0)
+    assert rule.observe(None) == (None, False)  # unbound: no data
+
+
+def test_add_rule_requires_federation():
+    from loghisto_tpu.system import TPUMetricSystem
+    from loghisto_tpu.window.rules import FreshnessSloRule
+
+    ms = TPUMetricSystem(interval=0.5, sys_stats=False, num_metrics=16,
+                         retention=True)
+    try:
+        with pytest.raises(ValueError, match="federation"):
+            ms.add_rule(FreshnessSloRule("fresh", budget_us=1e6))
+    finally:
+        ms.stop()
+
+
+# -- system wiring: publish-complete freshness, gauges, /fleetz ----------- #
+
+
+def test_system_freshness_completes_at_publish_and_serves_gauges():
+    from loghisto_tpu.prometheus import PrometheusEndpoint
+    from loghisto_tpu.system import TPUMetricSystem
+    from loghisto_tpu.window.rules import FreshnessSloRule
+
+    ms = TPUMetricSystem(
+        interval=0.2, sys_stats=False, num_metrics=64,
+        retention=True, observability=True,
+        federation=FederationConfig(expected_emitters=1),
+    )
+    assert ms.federation.has_publisher
+    assert ms.committer.freshness_hook == ms.federation.note_publish
+    assert ms.federation.skew_tolerance_s == 1.0
+    ms.add_rule(FreshnessSloRule("fresh", budget_us=60e6))
+    ms.start()
+    try:
+        e = FederationEmitter(("127.0.0.1", ms.federation.port),
+                              interval=0.2, emitter_id=55)
+        e._sender.start_sender("sys-test")
+        for v in (1.0, 10.0, 100.0):
+            e.record("fed.sys.lat", v)
+        e.flush()
+        assert e.drain(10.0)
+        # completes only once the commit path publishes the interval
+        _wait(lambda: ms.federation.stats()["freshness_samples"] >= 1,
+              what="publish-completed freshness")
+        assert ms.federation.stats()["freshness_pending"] == 0
+
+        with ms._gauge_lock:
+            gauges = set(ms._gauge_funcs)
+        assert {"fed.freshness_p99_us", "fed.freshness_pending",
+                "federation.MaxEmitterSkewS", "obs.SpansDropped",
+                "health.fleet_freshness_stall",
+                "health.emitter_clock_skew",
+                f"fed.emitter.{55:016x}.freshness_p99_us"} <= gauges
+
+        dump = ms.debug_dump()
+        assert dump["obs"]["saturated"] in (False, True)
+        assert dump["federation"]["freshness_samples"] >= 1
+
+        ep = PrometheusEndpoint(ms, port=0, host="127.0.0.1")
+        ep.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{ep.port}/fleetz", timeout=5
+            ) as r:
+                doc = json.loads(r.read())
+            assert f"{55:016x}" in doc["emitters"]
+            assert doc["fleet"]["freshness_samples"] >= 1
+        finally:
+            ep.stop()
+        e.close(drain_timeout=1.0)
+    finally:
+        ms.stop()
+
+
+def test_fleetz_404_without_federation():
+    from loghisto_tpu.metrics import MetricSystem
+    from loghisto_tpu.prometheus import PrometheusEndpoint
+
+    ms = MetricSystem(interval=60.0, sys_stats=False)
+    ep = PrometheusEndpoint(ms, port=0, host="127.0.0.1")
+    ep.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ep.port}/fleetz", timeout=5
+            )
+        assert ei.value.code == 404
+    finally:
+        ep.stop()
+        ms.stop()
+
+
+def test_spans_dropped_gauge_tracks_ring_saturation():
+    from loghisto_tpu.obs import ObsConfig
+    from loghisto_tpu.system import TPUMetricSystem
+
+    ms = TPUMetricSystem(interval=60.0, sys_stats=False, num_metrics=16,
+                         observability=ObsConfig(capacity=8, health=False))
+    try:
+        with ms._gauge_lock:
+            fn = ms._gauge_funcs["obs.SpansDropped"]
+        assert fn() == 0.0
+        t = time.perf_counter_ns()
+        for i in range(20):  # 20 records into an 8-slot ring
+            ms.obs.record("spam", t, t + 1, 1)
+        assert fn() == float(ms.obs.dropped) > 0.0
+        assert ms.debug_dump()["obs"]["saturated"]
+    finally:
+        ms.stop()
+
+
+# -- the 32-emitter drill -------------------------------------------------- #
+
+
+@pytest.mark.slow
+def test_32_emitter_fleet_drill(tmp_path):
+    """32 emitter subprocesses (one intentionally wedged after phase 0)
+    against one real aggregator pod: the merged Perfetto trace carries
+    unbroken fed flows across the process boundary, ``fed.FreshnessUs``
+    p99 served through the normal query path is bit-identical to a
+    host-side oracle over the receiver's freshness ledger, and /fleetz
+    names the wedged emitter."""
+    import os
+
+    from loghisto_tpu.ops.stats import (
+        bucket_representatives, percentiles_sparse,
+    )
+    from loghisto_tpu.prometheus import PrometheusEndpoint
+    from loghisto_tpu.system import TPUMetricSystem
+
+    from loghisto_tpu.obs import ObsConfig
+
+    # three phases: everyone ships phase 0; the wedged emitter goes
+    # dark at phase 1 while the rest keep shipping AND heartbeating
+    # through the stdin-sync windows (their tickers stay live), so the
+    # /fleetz inspection between phases 1 and 2 sees a running fleet
+    # with exactly one silent member
+    # interval 0.5s: the commit bridge rides a depth-8 channel, and 32
+    # subprocesses contending for CPU can stall a commit past a short
+    # interval — a dropped interval would lose its freshness samples
+    # and break the bit-identity oracle below
+    N, PHASES, WEDGED = 32, 3, 31
+    ms = TPUMetricSystem(
+        interval=0.5, sys_stats=False, num_metrics=128, config=CFG,
+        retention=True, observability=ObsConfig(capacity=16384),
+        federation=FederationConfig(expected_emitters=N),
+    )
+    ms.federation.starvation_s = 2.0  # a wedged emitter flags quickly
+    ms.start()
+    port = ms.federation.port
+    trace_dir = tmp_path / "traces"
+    trace_dir.mkdir()
+    procs = []
+    for i in range(N):
+        env = dict(os.environ)
+        if i < 4:  # four traced emitters keep the merge cheap
+            env["LOGHISTO_FED_TRACE"] = str(trace_dir / f"em{i}.json")
+        if i == WEDGED:
+            env["LOGHISTO_FED_WEDGE"] = "1"
+        procs.append(subprocess.Popen(
+            [sys.executable, REPO_WORKER, str(port), str(i), str(PHASES)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env,
+        ))
+    try:
+        fed = ms.federation
+        spp = SAMPLES_PER_PHASE
+        _wait(lambda: fed.samples_merged == N * spp, timeout=240.0,
+              what="phase-0 fan-in")
+        for p in procs:
+            p.stdin.write("go\n")
+            p.stdin.flush()
+        after_p1 = N * spp + (N - 1) * spp  # WEDGED sits phase 1 out
+        _wait(lambda: fed.samples_merged == after_p1, timeout=240.0,
+              what="phase-1 fan-in")
+        # the fleet idles at the stdin sync: live emitters heartbeat,
+        # the wedged one crossed its last flush at phase 0.  Let it age
+        # past the starvation window, then ask /fleetz who went dark.
+        time.sleep(2.5)
+        ep = PrometheusEndpoint(ms, port=0, host="127.0.0.1")
+        ep.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{ep.port}/fleetz", timeout=5
+            ) as r:
+                doc = json.loads(r.read())
+        finally:
+            ep.stop()
+        wedged_eid = f"{10_000 + WEDGED:016x}"
+        assert wedged_eid in doc["flags"]["starved"], doc["flags"]
+        assert doc["emitters"][wedged_eid]["stalled"]
+        live_eid = f"{10_000:016x}"
+        assert not doc["emitters"][live_eid]["stalled"]
+        assert doc["fleet"]["emitters"] == N
+        assert doc["emitters"][live_eid]["stage_p99_us"]  # health rode
+
+        for p in procs:
+            p.stdin.write("go\n")
+            p.stdin.flush()
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            assert p.returncode == 0, out[-2000:]
+            assert " OK " in out, out[-2000:]
+        total = N * spp + 2 * (N - 1) * spp
+        _wait(lambda: fed.samples_merged == total, timeout=240.0,
+              what="phase-2 fan-in")
+
+        # freshness never dropped from the oracle ledger, and every
+        # applied frame completed through the publish hook
+        _wait(lambda: fed.stats()["freshness_pending"] == 0,
+              timeout=30.0, what="pending freshness drains")
+        time.sleep(0.6)  # two commit intervals: straggler heartbeats
+        _wait(lambda: fed.stats()["freshness_pending"] == 0,
+              timeout=30.0, what="straggler heartbeats complete")
+        st = fed.stats()
+        assert st["freshness_dropped"] == 0
+        assert st["freshness_samples"] == len(fed.freshness_values) > 0
+
+        # merged trace: dump the aggregator ring while the traced
+        # emitters' final frames are still the freshest spans in it
+        rx_trace = str(tmp_path / "rx.json")
+        dump_perfetto(ms.obs, rx_trace, process_name="aggregator")
+
+        # fed.FreshnessUs p99 through the NORMAL query path must be
+        # bit-identical to the host oracle folding the same ledger
+        vals = np.asarray(fed.freshness_values, dtype=np.float64)
+
+        def _served():
+            ms.aggregator.wait_transfers()
+            res = ms.retention.query(
+                "fed.FreshnessUs", 3600.0, percentiles=(0.99,)
+            )
+            return res.metrics.get("fed.FreshnessUs")
+
+        _wait(lambda: (_served() or {}).get("count") == len(vals),
+              timeout=30.0, what="freshness samples become queryable")
+        served = _served()["p99"]
+        folded = np.clip(
+            compress_np(vals, CFG.precision),
+            -CFG.bucket_limit, CFG.bucket_limit,
+        )
+        buckets, counts = np.unique(folded, return_counts=True)
+        # host-side bucket selection: the reference cumsum rule in
+        # float64 picks WHICH bucket is the p99 (the statistical claim)
+        cdf = np.cumsum(counts.astype(np.uint64))
+        sel = int(np.searchsorted(
+            cdf.astype(np.float64) / float(cdf[-1]), 0.99, side="left"
+        ))
+        p99_bucket = int(buckets[min(sel, len(buckets) - 1)])
+        # ...decoded through the same canonical float32 representative
+        # table the query kernel serves — the full pipeline (wire stamps
+        # -> histogram fold -> fused commit -> snapshot query) must land
+        # on the identical bits
+        oracle = float(np.asarray(bucket_representatives(
+            CFG.bucket_limit, CFG.precision
+        ))[p99_bucket + CFG.bucket_limit])
+        assert served == oracle
+        # and the float64 host percentile agrees up to f32 decode
+        ref64 = float(percentiles_sparse(
+            buckets, counts, np.asarray([0.99]), CFG.precision
+        )[0])
+        np.testing.assert_allclose(served, ref64, rtol=1e-6)
+
+        # unbroken fed flows across the process boundary
+        em_traces = sorted(str(p) for p in trace_dir.glob("em*.json"))
+        assert len(em_traces) == 4
+        doc = merge_traces(em_traces + [rx_trace])
+        by_flow = {}
+        for ev in doc["traceEvents"]:
+            if ev.get("cat") == "fed":
+                by_flow.setdefault(ev["id"], []).append(ev)
+        crossing = 0
+        for fid, evs in by_flow.items():
+            evs.sort(key=lambda e: e["ts"])
+            phs = [e["ph"] for e in evs]
+            assert phs[0] == "s" and set(phs[1:]) <= {"t"}, (fid, phs)
+            if len({e["pid"] for e in evs}) > 1:
+                crossing += 1
+        assert crossing > 0  # arrows actually span processes
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        ms.stop()
