@@ -619,6 +619,36 @@ def main() -> None:
         print(f"bench: fleet-obs stage failed: {e}", file=sys.stderr)
     ready10.set()
 
+    # fused-ingest headline (benchmarks/fused_ingest_bench.py has the
+    # crossover sweep and full shape): the r13 one-dispatch
+    # sample->scatter kernel's samples/s, and the double-buffered
+    # upload/compute overlap as attributed by the aggregator's own
+    # ingest.upload/ingest.dispatch span streams.  On CPU the kernel is
+    # interpret-mode (calibration only, orders slower than Mosaic), so
+    # the shape shrinks to keep the stage bounded; a --tpu capture
+    # reruns the bench at the 10k-metric headline shape.
+    ready11 = _start_watchdog(300.0, on_timeout=lambda: print(
+        json.dumps(result), flush=True
+    ))
+    try:
+        from benchmarks.fused_ingest_bench import run as fused_run
+        from benchmarks.fused_ingest_bench import run_overlap
+
+        if platform == "tpu":
+            fu = fused_run(reps=3)
+        else:
+            fu = fused_run(num_metrics=1024, bucket_limit=512,
+                           batch=1 << 16, reps=2)
+        result["fused_ingest_sps"] = fu["fused"]["samples_per_s"]
+        result["fused_ingest_suspect"] = fu["fused"]["suspect"]
+        result["fused_ingest_interpret"] = fu["pallas_interpret"]
+        result["fused_over_scatter"] = fu["fused_over_scatter"]
+        ov = run_overlap(rounds=2)
+        result["ingest_overlap_pct"] = ov["ingest_overlap_pct"]
+    except Exception as e:  # never let the extra metric kill the bench
+        print(f"bench: fused-ingest stage failed: {e}", file=sys.stderr)
+    ready11.set()
+
     print(json.dumps(result))
 
 

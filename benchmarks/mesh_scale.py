@@ -182,6 +182,31 @@ def run(num_metrics: int = 10_000, bucket_limit: int = 4_096,
             t_col.append(time.perf_counter() - t0)
         t_collect = float(np.median(t_col))
         del acc, partial, stats
+
+        # -- r13 async stream psum: issue the collective via
+        # collect.start (no fresh-partial output, so the next interval's
+        # fold is not a data-dependent consumer), overlap the next
+        # batch's shard-local fold, then fetch.  Compare against the
+        # serial collect-then-ingest pair measured above.
+        acc = make_sharded_accumulator(mesh, num_metrics, cfg.num_buckets)
+        partial = ingest(make_partial(), ids, values)
+        jax.block_until_ready(partial)
+        acc, stats = collect.start(acc, partial)  # compile + warm
+        np.asarray(stats["counts"])
+        t_ov = []
+        for _ in range(reps):
+            partial = ingest(make_partial(), ids, values)
+            jax.block_until_ready(partial)
+            t0 = time.perf_counter()
+            acc, stats = collect.start(acc, partial)
+            nxt = ingest(make_partial(), ids, values)  # overlaps the psum
+            np.asarray(stats["counts"])
+            jax.block_until_ready(nxt)
+            t_ov.append(time.perf_counter() - t0)
+        t_overlap = float(np.median(t_ov))
+        del acc, partial, nxt, stats
+        t_serial_pair = t_collect + t_ingest
+
         result["steps"][key + "_interval"] = {
             "ingest_seconds_per_batch": round(t_ingest, 4),
             "collect_seconds": round(t_collect, 4),
@@ -190,6 +215,12 @@ def run(num_metrics: int = 10_000, bucket_limit: int = 4_096,
             # effective per-batch cost at 10 batches/interval
             "per_batch_at_10_vs_single": round(
                 (t_ingest + t_collect / 10) / t_single, 3
+            ),
+            # collect + next batch, serial vs collective-overlapped
+            "collect_plus_batch_serial_seconds": round(t_serial_pair, 4),
+            "collect_plus_batch_overlap_seconds": round(t_overlap, 4),
+            "async_psum_saving_pct": round(
+                100.0 * (1.0 - t_overlap / max(t_serial_pair, 1e-9)), 1
             ),
         }
     return result

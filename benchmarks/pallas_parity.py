@@ -106,6 +106,53 @@ def main():
         print("PARITY FAIL multirow-2step")
         failures += 1
 
+    # --- r13 fused sample->scatter kernel vs scatter oracle ---
+    from loghisto_tpu.ops.fused_ingest import ROWS_TILE, make_fused_ingest_fn
+
+    for m in (16, 1024, 10_000 // ROWS_TILE * ROWS_TILE):
+        # ids straddle both droppable sides and every row-tile boundary
+        ids = rng.integers(-2, m + 2, n).astype(np.int32)
+        ids[:ROWS_TILE] = np.arange(ROWS_TILE)      # first tile, each row
+        ids[ROWS_TILE:2 * ROWS_TILE] = m - 1        # last row
+        ref = np.asarray(
+            scatter(jnp.zeros((m, cfg.num_buckets), jnp.int32), ids, values)
+        )
+        fused = make_fused_ingest_fn(cfg.bucket_limit)
+        got = np.asarray(
+            fused(jnp.zeros((m, cfg.num_buckets), jnp.int32), ids, values)
+        )
+        if np.array_equal(ref, got):
+            print(f"PARITY OK  fused m={m:<5} sum={got.sum()}")
+        else:
+            bad = np.nonzero(ref != got)
+            print(f"PARITY FAIL fused m={m} {bad[0].size} cells differ")
+            failures += 1
+
+    # fused two-step accumulation through the donated alias
+    m = 64
+    ids = rng.integers(0, m, n).astype(np.int32)
+    ref = scatter(jnp.zeros((m, cfg.num_buckets), jnp.int32), ids, values)
+    ref = np.asarray(scatter(ref, ids[::-1].copy(), values))
+    fused = make_fused_ingest_fn(cfg.bucket_limit)
+    acc = fused(jnp.zeros((m, cfg.num_buckets), jnp.int32), ids, values)
+    got = np.asarray(fused(acc, ids[::-1].copy(), values))
+    if np.array_equal(ref, got):
+        print(f"PARITY OK  fused-2step m={m} sum={got.sum()}")
+    else:
+        print("PARITY FAIL fused-2step")
+        failures += 1
+
+    # fused empty batch (grid degenerates to the single filler tile)
+    got = np.asarray(fused(
+        jnp.zeros((m, cfg.num_buckets), jnp.int32),
+        np.zeros(0, np.int32), np.zeros(0, np.float32),
+    ))
+    if got.sum() == 0:
+        print("PARITY OK  fused-empty")
+    else:
+        print("PARITY FAIL fused-empty")
+        failures += 1
+
     print(f"pallas parity: {'ALL OK' if not failures else f'{failures} FAILURES'}")
     return 1 if failures else 0
 

@@ -50,6 +50,29 @@ PALLAS_SINGLE_METRIC = True
 # awaiting a hardware ranking).  Capture-overridable like the rest.
 HIGH_CARDINALITY_KERNEL = "sort"
 
+# Whether auto considers the r13 fused sample->scatter Pallas kernel
+# (ops/fused_ingest.py: codec on the VPU inside the kernel, one
+# dispatch, no HBM bucket-index array) at high metric cardinality on
+# TPU.  It replaces the sort-dedup pick where capable; when
+# fused_ingest_incapability names a blocker (mesh-embedded step, row
+# tile, dtype, batch too small/unknown) auto degrades to the pre-r13
+# winner.  Capture-overridable.
+FUSED_INGEST = True
+
+# Minimum batch the fused kernel's XLA sort+layout preprocess amortizes
+# over: below this the plain scatter's per-sample random access is
+# cheaper than sorting the batch and padding block segments to
+# SAMPLE_TILE boundaries.  Baked FALLBACK from the r13 CPU-host
+# calibration sweep (benchmarks/fused_ingest_bench.py, FUSED_INGEST_r13
+# "crossover" section); a hardware capture retunes it via the committed
+# JSON like every other threshold.
+FUSED_MIN_BATCH = 1 << 17
+
+# Metric rows per fused-kernel accumulator block; mirrored from
+# fused_ingest.ROWS_TILE without importing jax (this module must stay
+# importable without jax — analyze_capture.py depends on that).
+FUSED_ROWS_TILE = 8
+
 # Dense one-hot matmul materializes an [N, B] one-hot per tile; the r2
 # table shows it never beating scatter on hardware at >=16 metrics, and
 # losing to the Pallas row kernel at M=1 — it remains available for
@@ -100,6 +123,7 @@ def _load_thresholds() -> None:
     global SORT_MIN_METRICS, PALLAS_SINGLE_METRIC, THRESHOLDS_SOURCE
     global HIGH_CARDINALITY_KERNEL, FUSED_COMMIT
     global SPARSE_DENSITY_CROSSOVER, SPARSE_KERNEL
+    global FUSED_INGEST, FUSED_MIN_BATCH
     try:
         with open(THRESHOLDS_FILE) as f:
             table = _json.load(f)
@@ -137,11 +161,70 @@ def _load_thresholds() -> None:
     if sk in ("jnp", "pallas"):
         SPARSE_KERNEL = sk
         applied = True
+    fi = table.get("fused_ingest")
+    if isinstance(fi, bool):
+        FUSED_INGEST = fi
+        applied = True
+    fmb = table.get("fused_min_batch")
+    if isinstance(fmb, int) and not isinstance(fmb, bool) and fmb >= 1:
+        FUSED_MIN_BATCH = fmb
+        applied = True
     if applied:  # never cite a table that contributed nothing
         THRESHOLDS_SOURCE = str(table.get("source", THRESHOLDS_FILE))
 
 
 _load_thresholds()
+
+
+def fused_ingest_incapability(
+    num_metrics: int,
+    batch_size: int | None = None,
+    mesh: bool = False,
+    acc_dtype: str = "int32",
+    crossover: bool = True,
+) -> str | None:
+    """Why a configuration genuinely cannot (or should not) run the r13
+    fused sample->scatter kernel, as a human-readable reason string — or
+    None when it can.  Mirrors ``mesh_commit_incapability``'s shape:
+    "auto" degrades silently on a reason, an EXPLICIT
+    ``ingest_path="fused"`` surfaces the same string in its raise, so
+    the operator always learns WHY fused ingest was declined.
+
+    ``crossover=False`` skips the amortization checks (batch unknown /
+    batch too small) — those are performance policy, not correctness,
+    and an explicit selection is allowed to eat the preprocess cost.
+    """
+    if mesh:
+        return (
+            "mesh shape: the fused kernel does not run inside a "
+            "shard_map-embedded step (pallas_call under shard_map is not "
+            "hardware-validated; the sharded path keeps its dispatched "
+            "local fold)"
+        )
+    if num_metrics % FUSED_ROWS_TILE:
+        return (
+            f"mesh shape: num_metrics={num_metrics} does not divide by "
+            f"the fused kernel's {FUSED_ROWS_TILE}-row metric tile"
+        )
+    if acc_dtype != "int32":
+        return (
+            f"dtype: accumulator dtype {acc_dtype} is not int32 — the "
+            "fused kernel's per-tile f32 one-hot accumulation is "
+            "integer-exact only against the int32 dense layout"
+        )
+    if crossover and batch_size is None:
+        return (
+            "batch too small: batch size unknown, cannot prove the "
+            f"sort+layout preprocess amortizes (needs >= {FUSED_MIN_BATCH} "
+            "samples/batch)"
+        )
+    if crossover and batch_size is not None and batch_size < FUSED_MIN_BATCH:
+        return (
+            f"batch too small: {batch_size} samples/batch does not "
+            "amortize the fused kernel's sort+layout preprocess "
+            f"(measured crossover {FUSED_MIN_BATCH})"
+        )
+    return None
 
 
 def choose_ingest_path(
@@ -154,7 +237,11 @@ def choose_ingest_path(
     any measured config, so "auto" does not select it.  The Pallas row
     kernel (winner at M=1) participates via its masked
     pallas_row_ingest_batch form, which has the standard (ids, values)
-    contract (see PALLAS_SINGLE_METRIC note on the extrapolation).
+    contract (see PALLAS_SINGLE_METRIC note on the extrapolation).  At
+    high cardinality on TPU the r13 fused sample->scatter kernel is the
+    preferred pick (one dispatch, codec on-chip); resolve_ingest_path
+    degrades it to HIGH_CARDINALITY_KERNEL when
+    ``fused_ingest_incapability`` names a blocker.
     """
     if platform == "tpu" and num_metrics == 1 and PALLAS_SINGLE_METRIC:
         # the fused Pallas row kernel wins the single-metric config
@@ -162,6 +249,8 @@ def choose_ingest_path(
         # makes it contract-compatible with the other paths
         return "pallas"
     if platform == "tpu" and num_metrics >= SORT_MIN_METRICS:
+        if FUSED_INGEST:
+            return "fused"
         return HIGH_CARDINALITY_KERNEL
     return "scatter"
 
@@ -203,6 +292,12 @@ def resolve_ingest_path(
         # auto never raises for a precondition: it just doesn't pick the
         # kernel the shape/batch would invalidate
         path = choose_ingest_path(num_metrics, num_buckets, platform)
+        if path == "fused" and fused_ingest_incapability(
+            guard, batch_size=batch_size, mesh=mesh
+        ) is not None:
+            # degrade to the pre-r13 high-cardinality winner, which then
+            # takes its own shape validation below
+            path = HIGH_CARDINALITY_KERNEL
         if path in ("sort", "sortscan"):
             try:
                 validate_flat_cell_shape(guard, num_buckets, path)
@@ -218,6 +313,14 @@ def resolve_ingest_path(
             # precondition, and the step is not shard_map-embedded
             path = "scatter"
         return path
+    if path == "fused":
+        # explicit selection: correctness blockers raise with the reason
+        # string; the crossover (a perf policy) is the operator's call
+        reason = fused_ingest_incapability(
+            guard, batch_size=batch_size, mesh=mesh, crossover=False
+        )
+        if reason is not None:
+            raise ValueError(f"fused ingest unavailable: {reason}")
     if path in ("sort", "sortscan", "matmul"):
         validate_flat_cell_shape(guard, num_buckets, path)
     elif path in ("hybrid", "pallas") and batch_too_big:
@@ -368,11 +471,15 @@ def ingest_step_fn(path: str):
         from loghisto_tpu.ops.pallas_kernels import pallas_row_ingest_batch
 
         return pallas_row_ingest_batch
+    if path == "fused":
+        from loghisto_tpu.ops.fused_ingest import fused_ingest_batch
+
+        return fused_ingest_batch
     if path != "scatter":
         raise ValueError(
             f"no pure step form for ingest_path {path!r}: expected "
-            "'scatter', 'sort', 'sortscan', 'hybrid', 'matmul', or "
-            "'pallas'"
+            "'scatter', 'sort', 'sortscan', 'hybrid', 'matmul', "
+            "'pallas', or 'fused'"
         )
     from loghisto_tpu.ops.ingest import ingest_batch
 

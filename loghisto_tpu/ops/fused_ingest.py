@@ -1,0 +1,250 @@
+"""Fused sample->scatter Pallas TPU ingest: raw values + metric ids to
+dense [M, B] int32 accumulator in ONE device dispatch.
+
+Every prior multi-metric path splits the work in two: a compress stage
+that materializes a bucket-index array in HBM, then a scatter (or
+one-hot matmul) stage that consumes it.  The circllhist observation
+(PAPERS.md) is that log-linear bucket selection is pure bit/exponent
+arithmetic — VPU work that belongs in the SAME kernel as the
+accumulate, like SNIPPETS.md [2]'s histogram kernel which avoids
+``searchsorted`` for exactly this reason.  This module fuses the whole
+pipeline:
+
+  1. (XLA preprocess, all static shapes, fused into the same jitted
+     program) group samples by *metric row block* (rows_tile consecutive
+     rows) with one sort, and lay the RAW values out so every
+     SAMPLE_TILE-sized tile holds samples of exactly one block — the
+     ``pallas_multirow.py`` tiling idiom, except no bucket index is ever
+     computed here: the layout carries float32 values, not bucket ids.
+  2. (Pallas kernel) grid over sample tiles routed by a
+     scalar-prefetched ``tile_block`` map.  Each tile compresses its
+     values on the VPU (``bucket_indices`` — the same codec function as
+     the scatter path, so the contract can never diverge), forms the
+     one-hots in VMEM, and accumulates a [rows_tile*H, 128] matmul on
+     the MXU straight into the aliased accumulator block.
+
+Compared to the multirow kernel this (a) moves the codec on-chip — the
+bucket-index array never exists in HBM — and (b) drops the lane-padded
+accumulator layout: the acc block is (rows_tile, B) with B equal to the
+array's own minor dim, which Mosaic accepts (a block dim may equal the
+array dim instead of being 8/128-divisible), so the kernel aliases the
+product's [M, B] accumulator directly and plugs into the uniform
+``f(acc, ids, values, bucket_limit, precision)`` dispatch contract.
+
+Exactness contract (same as every other path): per-tile f32 one-hot
+accumulation is bounded by SAMPLE_TILE < 2^24 before the int32 cast;
+cross-tile accumulation is integer; per-cell overflow at 2^31 is the
+caller's spill bound.  Invalid ids (< 0 or >= M) take the filler row,
+which the one-hot drops — bit-identical to sanitize_ids + mode="drop".
+
+The jnp fallback for CPU/GPU is ``ops.ingest.ingest_batch`` itself —
+re-exported as ``fused_ingest_reference`` — because that scatter
+composition IS the semantics the kernel must reproduce bit-for-bit
+(tests/test_fused_ingest.py pins the parity across denormals, negative
+values, inf/NaN sanitization, row-boundary ids, and empty batches).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from loghisto_tpu.config import PRECISION
+from loghisto_tpu.ops.backend import default_interpret
+from loghisto_tpu.ops.ingest import bucket_indices
+from loghisto_tpu.ops.ingest import ingest_batch as fused_ingest_reference  # noqa: F401
+from loghisto_tpu.ops.pallas_kernels import LANES, SAMPLE_TILE
+
+# Metric rows per accumulator block resident in VMEM.  8 matches the
+# multirow kernel (and the sublane tile), keeps the one-hot column space
+# rows_tile*H narrow enough for VMEM at 8k buckets, and is what
+# TPUAggregator._grow_row_unit preserves under registry growth.
+ROWS_TILE = 8
+
+
+def preprocess_values(
+    ids: jnp.ndarray,
+    values: jnp.ndarray,
+    num_metrics: int,
+    rows_tile: int = ROWS_TILE,
+    sample_tile: int = SAMPLE_TILE,
+):
+    """Sort and block-pad one RAW batch (no bucketing happens here).
+
+    Returns (layout_rows [G*T] int32, layout_vals [G*T] float32,
+    tile_block [G] int32) with G = ceil(N/T) + n_blocks (static): every
+    tile's samples belong to one metric block, filler entries carry
+    row == rows_tile (dropped by the kernel's one-hot) and value 0.0.
+    The searchsorted below routes TILES to blocks (an O(G) map over
+    static shapes) — per-sample bucket selection stays on the VPU
+    inside the kernel.
+    """
+    n = ids.shape[0]
+    t = sample_tile
+    n_blocks = num_metrics // rows_tile
+    g = (n + t - 1) // t + n_blocks
+
+    values = values.astype(jnp.float32)
+    valid = (ids >= 0) & (ids < num_metrics)
+    block = jnp.where(valid, ids // rows_tile, n_blocks - 1)
+    row_in_block = jnp.where(
+        valid, ids - block * rows_tile, rows_tile  # filler drops in one-hot
+    )
+
+    order = jnp.argsort(block)
+    sorted_block = block[order]
+    sorted_row = row_in_block[order]
+    sorted_vals = values[order]
+
+    counts = jnp.bincount(sorted_block, length=n_blocks)
+    tiles_per_block = (counts + t - 1) // t
+    start_tile = jnp.concatenate(
+        [jnp.zeros(1, dtype=tiles_per_block.dtype),
+         jnp.cumsum(tiles_per_block)[:-1]]
+    )
+    padded_start = start_tile * t
+    sample_start = jnp.concatenate(
+        [jnp.zeros(1, dtype=counts.dtype), jnp.cumsum(counts)[:-1]]
+    )
+    rank = jnp.arange(n) - sample_start[sorted_block]
+    dest = padded_start[sorted_block] + rank
+
+    layout_rows = jnp.full(g * t, rows_tile, dtype=jnp.int32)
+    layout_vals = jnp.zeros(g * t, dtype=jnp.float32)
+    layout_rows = layout_rows.at[dest].set(sorted_row.astype(jnp.int32))
+    layout_vals = layout_vals.at[dest].set(sorted_vals)
+
+    tile_ids = jnp.arange(g)
+    tile_block = (
+        jnp.searchsorted(start_tile, tile_ids, side="right") - 1
+    ).astype(jnp.int32)
+    tile_block = jnp.clip(tile_block, 0, n_blocks - 1)
+    return layout_rows, layout_vals, tile_block
+
+
+def _kernel(tile_block_ref, rows_ref, vals_ref, acc_in_ref, acc_out_ref, *,
+            rows_tile: int, h: int, num_buckets: int, bucket_limit: int,
+            precision: int):
+    i = pl.program_id(0)
+    rows = rows_ref[0, :]
+    v = vals_ref[0, :]
+    # the fused step: codec on the VPU, inside the kernel — shared with
+    # the scatter path so sign mirroring, NaN->bucket 0, and saturation
+    # can never diverge (filler values are 0.0; their row drops them)
+    bucket = bucket_indices(v, bucket_limit, precision)
+    hi = bucket // LANES
+    lo = bucket % LANES
+    col = rows * h + hi  # filler rows land at >= rows_tile*h -> one-hot 0
+    onehot_col = jax.nn.one_hot(col, rows_tile * h, dtype=jnp.bfloat16)
+    onehot_lo = jax.nn.one_hot(lo, LANES, dtype=jnp.bfloat16)
+    partial = jax.lax.dot_general(
+        onehot_col, onehot_lo,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).reshape(rows_tile, h * LANES).astype(jnp.int32)[:, :num_buckets]
+
+    # Consecutive tiles of one block keep the output block resident; the
+    # aliased INPUT block may be re-fetched stale on revisits, so it is
+    # only read on the block's first tile (see pallas_multirow._kernel).
+    first_visit = jnp.logical_or(
+        i == 0, tile_block_ref[i] != tile_block_ref[jnp.maximum(i - 1, 0)]
+    )
+
+    @pl.when(first_visit)
+    def _init():
+        acc_out_ref[:] = acc_in_ref[:] + partial
+
+    @pl.when(jnp.logical_not(first_visit))
+    def _accumulate():
+        acc_out_ref[:] = acc_out_ref[:] + partial
+
+
+def fused_ingest_batch(
+    acc: jnp.ndarray,
+    ids: jnp.ndarray,
+    values: jnp.ndarray,
+    bucket_limit: int,
+    precision: int = PRECISION,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Uniform-contract fused step: acc int32 [M, B] (B = 2*bl+1,
+    M % ROWS_TILE == 0), f(acc, ids, values) -> acc, ONE pallas_call and
+    zero scatter dispatches (tests pin the jaxpr).  f64 values are cast
+    to f32 at entry — the same canonicalization every other path gets.
+    """
+    if interpret is None:
+        interpret = default_interpret()
+    if acc.ndim != 2:
+        raise ValueError(f"acc must be [M, B]; got shape {tuple(acc.shape)}")
+    num_metrics, num_buckets = acc.shape
+    if num_buckets != 2 * bucket_limit + 1:
+        raise ValueError(
+            f"acc has {num_buckets} buckets but bucket_limit={bucket_limit} "
+            f"implies {2 * bucket_limit + 1}"
+        )
+    if num_metrics % ROWS_TILE:
+        raise ValueError(
+            f"fused ingest needs num_metrics % {ROWS_TILE} == 0; got "
+            f"{num_metrics} (dispatch declines this shape before tracing)"
+        )
+    h = (num_buckets + LANES - 1) // LANES
+
+    rows, vals, tile_block = preprocess_values(
+        ids, values, num_metrics, ROWS_TILE
+    )
+    g = tile_block.shape[0]
+    kernel = functools.partial(
+        _kernel, rows_tile=ROWS_TILE, h=h, num_buckets=num_buckets,
+        bucket_limit=bucket_limit, precision=precision,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(g,),
+        in_specs=[
+            # lane-axis grid over [1, G*T] layouts (see pallas_multirow:
+            # Mosaic rejects block [1, T] on a [G, T] array)
+            pl.BlockSpec((1, SAMPLE_TILE), lambda i, tb: (0, i)),
+            pl.BlockSpec((1, SAMPLE_TILE), lambda i, tb: (0, i)),
+            # acc block minor dim == array minor dim: legal without lane
+            # padding, so the product accumulator aliases directly
+            pl.BlockSpec((ROWS_TILE, num_buckets), lambda i, tb: (tb[i], 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (ROWS_TILE, num_buckets), lambda i, tb: (tb[i], 0)
+        ),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_metrics, num_buckets), jnp.int32),
+        # flattened input index incl. the scalar-prefetch operand:
+        # 0=tile_block, 1=rows, 2=vals, 3=acc
+        input_output_aliases={3: 0},
+        interpret=interpret,
+    )(
+        tile_block,
+        rows.reshape(1, g * SAMPLE_TILE),
+        vals.reshape(1, g * SAMPLE_TILE),
+        acc,
+    )
+
+
+def make_fused_ingest_fn(
+    bucket_limit: int,
+    precision: int = PRECISION,
+    interpret: bool | None = None,
+):
+    """Jitted, donated-accumulator fused step:
+    f(acc [M, B], ids [N], values [N]) -> acc, one device dispatch."""
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def ingest(acc, ids, values):
+        return fused_ingest_batch(
+            acc, ids, values, bucket_limit, precision, interpret=interpret
+        )
+
+    return ingest

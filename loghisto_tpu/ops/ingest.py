@@ -12,6 +12,16 @@ which is exactly what makes the batch/device design legal.
 The accumulator is donated, so steady-state ingest does not allocate.
 Out-of-range metric ids are dropped (mode="drop"), mirroring how the
 sparse tier simply cannot reference an unregistered name.
+
+RETIRED as the TPU high-cardinality default (r13): this composition is
+two device stages — compress materializes the bucket-index array in
+HBM, then the scatter consumes it — and ``ops/fused_ingest.py`` now
+does both in one Pallas dispatch with the codec on the VPU.  "auto"
+prefers the fused kernel wherever ``fused_ingest_incapability`` allows
+(ops/dispatch.py); what remains here is (a) the universal fallback for
+CPU/GPU, small batches, and mesh-embedded folds, and (b) the semantic
+oracle: ``fused_ingest_reference`` IS ``ingest_batch``, and the fused
+kernel must match it bit-for-bit (tests/test_fused_ingest.py).
 """
 
 from __future__ import annotations

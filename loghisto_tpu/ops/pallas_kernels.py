@@ -29,6 +29,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from loghisto_tpu.config import PRECISION
+# shared backend probe (ops/backend.py); the `_on_tpu` name stays
+# importable — window/lifecycle/anomaly/multirow all read it from here
+from loghisto_tpu.ops.backend import on_tpu as _on_tpu  # noqa: F401
 from loghisto_tpu.ops.ingest import bucket_indices
 
 LANES = 128
@@ -36,13 +39,6 @@ SAMPLE_TILE = 2048
 # float32 scratch accumulation is exact only below 2^24 per cell; bound the
 # whole call so no cell can saturate silently.
 MAX_SAMPLES_PER_CALL = 1 << 24
-
-
-def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
 
 
 def _hist_kernel(values_ref, acc_ref, out_ref, scratch_ref, *,

@@ -40,18 +40,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from loghisto_tpu.ops.backend import on_tpu as _on_tpu
 from loghisto_tpu.ops.ingest import sanitize_ids
 
 # Triples per Pallas grid step: small enough that the SMEM operand
 # blocks stay trivial, large enough to amortize grid overhead.
 TRIPLE_TILE = 256
-
-
-def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
 
 
 def sparse_ingest_batch(

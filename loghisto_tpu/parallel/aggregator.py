@@ -149,6 +149,26 @@ class IngestStagingRing:
         )
         return ids_dev, values_dev
 
+    def drain(self) -> None:
+        """Block until EVERY in-flight async upload has completed (or
+        surfaced its failure), then release the slots.  ``stage()`` only
+        waits for the slot it is about to reuse, so with the r13
+        double-buffered dispatch loop up to ``depth`` uploads can still
+        be in flight when the pipeline goes quiet — ``close()`` must
+        drain them all before the final interval commits, or a host
+        buffer could be torn down under a H2D copy still reading it.
+        Failed transfers are swallowed like in ``stage()``: their batch
+        was already requeued/shed by the failure path."""
+        for i, prev in enumerate(self._inflight):
+            if prev is None:
+                continue
+            self._inflight[i] = None
+            for arr in prev:
+                try:
+                    arr.block_until_ready()
+                except Exception:
+                    pass
+
 
 def local_histogram_fold(
     acc_local: jnp.ndarray,
@@ -298,7 +318,12 @@ def make_interval_distributed_step(
       collect(acc, partial) -> (acc, fresh_partial, stats)
           One psum over the stream axis, fold into the metric-sharded
           accumulator, stats on the merged rows; returns a zeroed
-          partial so the caller just rebinds both carries.
+          partial so the caller just rebinds both carries.  r13: the
+          collective is issued ASYNC — ``collect.start(acc, partial) ->
+          (acc, stats)`` exposes the raw program, whose outputs no
+          longer include the fresh partial, so folding the next batch
+          into an independent ``make_partial()`` overlaps the psum
+          instead of serializing behind it.
 
     Overflow contract (same int32 budget as the per-batch design): the
     partials and the accumulator are int32, and the worst case
@@ -351,14 +376,24 @@ def make_interval_distributed_step(
         merged = jax.lax.psum(partial_local[0], STREAM_AXIS)
         acc_local = acc_local + merged
         stats = dense_stats(acc_local, ps, bucket_limit, precision)
-        return acc_local, jnp.zeros_like(partial_local), stats
+        return acc_local, stats
 
     stats_specs = {
         "counts": P(METRIC_AXIS),
         "sums": P(METRIC_AXIS),
         "percentiles": P(METRIC_AXIS, None),
     }
-    collect = jax.jit(
+    # The psum program no longer RETURNS the fresh partial (pre-r13 it
+    # zeroed the donated one inside the same program): a fresh partial
+    # that is an output of the collect would make the next interval's
+    # first fold a data-dependent consumer of the collective, so XLA
+    # would serialize batch folds behind the psum.  Allocating it
+    # independently (make_partial below) breaks that edge — issuing
+    # ``collect_start`` and immediately folding the next batch overlaps
+    # the stream-axis collective with shard-local work.  Bit-identity is
+    # untouched: the int32 psum is order-independent (PR-8 invariant)
+    # and a zero partial is a zero partial wherever it comes from.
+    collect_start = jax.jit(
         shard_map(
             local_collect,
             mesh=mesh,
@@ -368,7 +403,6 @@ def make_interval_distributed_step(
             ),
             out_specs=(
                 P(METRIC_AXIS, None),
-                P(STREAM_AXIS, METRIC_AXIS, None),
                 stats_specs,
             ),
         ),
@@ -384,6 +418,19 @@ def make_interval_distributed_step(
             ),
             sharding,
         )
+
+    def collect(acc, partial):
+        """Compat form of the interval collect: issue the async psum
+        program (donates acc and partial) and hand back the pre-r13
+        (acc, fresh_partial, stats) triple.  The returned arrays are
+        un-fetched jax futures; callers that want the r13 overlap use
+        ``collect.start(acc, partial) -> (acc, stats)`` directly, grab a
+        fresh partial from make_partial(), and fold the next batch while
+        the collective is still in flight."""
+        acc, stats = collect_start(acc, partial)
+        return acc, make_partial(), stats
+
+    collect.start = collect_start
 
     return ingest, collect, make_partial
 
@@ -753,6 +800,19 @@ class TPUAggregator:
             )
         elif ingest_path == "pallas":
             self._ingest = self._make_dense_step_fn("pallas")
+        elif ingest_path == "fused":
+            # explicit selection: surface the correctness blockers with
+            # their reason strings at construction (auto resolved them
+            # above); the crossover is the operator's call here
+            from loghisto_tpu.ops.dispatch import fused_ingest_incapability
+
+            reason = fused_ingest_incapability(
+                num_metrics, batch_size=batch_size,
+                mesh=mesh is not None, crossover=False,
+            )
+            if reason is not None:
+                raise ValueError(f"ingest_path='fused': {reason}")
+            self._ingest = self._make_dense_step_fn("fused")
         elif ingest_path == "multirow":
             if mesh is not None:
                 raise ValueError(
@@ -772,8 +832,8 @@ class TPUAggregator:
         else:
             raise ValueError(
                 f"unknown ingest_path {ingest_path!r}: expected 'auto', "
-                "'scatter', 'matmul', 'sort', 'sortscan', 'hybrid', or "
-                "'multirow'"
+                "'scatter', 'matmul', 'sort', 'sortscan', 'hybrid', "
+                "'fused', or 'multirow'"
             )
         self.ingest_path = ingest_path
         self._weighted_ingest = make_weighted_ingest_fn(config.bucket_limit)
@@ -866,6 +926,8 @@ class TPUAggregator:
             return self.mesh.shape[METRIC_AXIS]
         if self.ingest_path == "multirow":
             return 8  # make_multirow_ingest's rows_tile default
+        if self.ingest_path == "fused":
+            return 8  # fused_ingest.ROWS_TILE: M must stay tile-divisible
         return 1
 
     def _grow_locked(self, target: Optional[int] = None) -> bool:
@@ -1238,6 +1300,16 @@ class TPUAggregator:
         signalled down and joined.  The aggregator stays usable: a later
         flush lazily re-spawns the worker."""
         self.flush(force=True)
+        # r13 double-buffering means up to ring-depth async uploads can
+        # still be in flight after the queue drains (stage() only waits
+        # for the slot it reuses, and a worker killed between items —
+        # e.g. by an agg.xfer_worker chaos fault — leaves its staged
+        # slot undispatched).  Drain them under _dev_lock so the final
+        # interval commit can never race a live H2D copy.
+        with self._dev_lock:
+            ring = self._staging_ring
+            if ring is not None:
+                ring.drain()
         with self._xfer_cv:
             self._xfer_stop = True
             self._xfer_cv.notify_all()
@@ -1382,14 +1454,68 @@ class TPUAggregator:
         self._xfer_samples_shipped += n
         self._ship_packed(packed)
 
+    def _dispatch_slot_locked(self, slot: tuple) -> Optional[int]:
+        """Consume one staged super-chunk (caller holds _dev_lock):
+        wait for the slot's async upload, record its "ingest.upload"
+        span (issue -> ready, i.e. the real H2D window — which overlaps
+        the PREVIOUS slot's "ingest.dispatch" span when the pipeline is
+        doing its job; benchmarks/fused_ingest_bench.py computes the
+        overlap percentage from exactly these two span streams), then
+        run the donated per-batch_size dispatches with the per-chunk
+        spill check.  Returns the absolute sample offset where work
+        failed, or None when the slot fully applied."""
+        soff, send, ids_dev, values_dev, t_issue = slot
+        bs = self.batch_size
+        rec = self.obs_recorder
+        try:
+            ids_dev.block_until_ready()
+            values_dev.block_until_ready()
+        except Exception:
+            self._on_device_failure_locked()
+            return soff
+        rec.record("ingest.upload", t_issue, time.perf_counter_ns())
+        with rec.span("ingest.dispatch"):
+            for off in range(soff, send, bs):
+                lo = off - soff
+                try:
+                    inj = self.fault_injector
+                    if inj is not None:
+                        # chaos hook inside the per-chunk net: an
+                        # injected device failure takes the organic
+                        # recovery (cooldown + requeue remainder)
+                        inj.check("agg.ingest")
+                    self._acc = self._ingest(
+                        self._acc,
+                        ids_dev[lo:lo + bs],
+                        values_dev[lo:lo + bs],
+                    )
+                    self._device_down_until = 0.0
+                    self._interval_ingested += min(bs, send - off)
+                    # int32 overflow guarantee: the check must run per
+                    # chunk — a force-flush of a large host backlog
+                    # could otherwise push a hot cell past 2^31
+                    # (worst case all samples hit one cell; threshold
+                    # + batch_size < 2^31 is validated at construction)
+                    if self._interval_ingested >= self.spill_threshold:
+                        self._spill_fold_locked()
+                except Exception:
+                    self._on_device_failure_locked()
+                    return off
+        return None
+
     def _process_raw(
         self, ids: np.ndarray, values: np.ndarray, n: int
     ) -> None:
-        """Raw transport device loop (worker thread): stage super-chunks
-        through the reusable ring (async upload overlapping the previous
-        slot's dispatches), dispatch per batch_size chunk under
-        _dev_lock with the per-chunk spill check, and requeue the
-        unapplied remainder on failure."""
+        """Raw transport device loop (worker thread): a true
+        double-buffered pipeline over the staging ring (r13).  Slot k+1
+        is staged — its async ``device_put`` issued — BEFORE slot k's
+        dispatches run, so the H2D copy of the next super-chunk proceeds
+        while the donated ingest dispatches consume the current one; the
+        per-slot "ingest.upload" / "ingest.dispatch" spans recorded by
+        _dispatch_slot_locked prove the overlap.  Failures preserve
+        exact sample conservation: everything before the failing offset
+        was applied, everything from it on is requeued from the host
+        arrays (which also covers a staged-but-undispatched next slot)."""
         bs = self.batch_size
         ring = self._staging_ring
         if ring is None or ring.slot_samples != 8 * bs:
@@ -1399,45 +1525,30 @@ class TPUAggregator:
         super_bs = ring.slot_samples
         retry_off = None
         with self._dev_lock:
+            pending: Optional[tuple] = None  # staged, not yet dispatched
             for soff in range(0, n, super_bs):
                 send = min(soff + super_bs, n)
+                t_issue = time.perf_counter_ns()
                 try:
                     ids_dev, values_dev = ring.stage(
                         ids[soff:send], values[soff:send]
                     )
+                    nxt = (soff, send, ids_dev, values_dev, t_issue)
                 except Exception:
-                    retry_off = soff
                     self._on_device_failure_locked()
-                    break
-                for off in range(soff, send, bs):
-                    lo = off - soff
-                    try:
-                        inj = self.fault_injector
-                        if inj is not None:
-                            # chaos hook inside the per-chunk net: an
-                            # injected device failure takes the organic
-                            # recovery (cooldown + requeue remainder)
-                            inj.check("agg.ingest")
-                        self._acc = self._ingest(
-                            self._acc,
-                            ids_dev[lo:lo + bs],
-                            values_dev[lo:lo + bs],
-                        )
-                        self._device_down_until = 0.0
-                        self._interval_ingested += min(bs, n - off)
-                        # int32 overflow guarantee: the check must run per
-                        # chunk — a force-flush of a large host backlog
-                        # could otherwise push a hot cell past 2^31
-                        # (worst case all samples hit one cell; threshold
-                        # + batch_size < 2^31 is validated at construction)
-                        if self._interval_ingested >= self.spill_threshold:
-                            self._spill_fold_locked()
-                    except Exception:
-                        retry_off = off
-                        self._on_device_failure_locked()
+                    nxt = None
+                if pending is not None:
+                    fail = self._dispatch_slot_locked(pending)
+                    pending = None
+                    if fail is not None:
+                        retry_off = fail
                         break
-                if retry_off is not None:
+                if nxt is None:
+                    retry_off = soff
                     break
+                pending = nxt
+            if retry_off is None and pending is not None:
+                retry_off = self._dispatch_slot_locked(pending)
         self._xfer_samples_shipped += (
             n if retry_off is None else retry_off
         )

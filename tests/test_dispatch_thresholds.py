@@ -18,6 +18,8 @@ def restore_dispatch_globals():
         dispatch.HIGH_CARDINALITY_KERNEL,
         dispatch.SPARSE_DENSITY_CROSSOVER,
         dispatch.SPARSE_KERNEL,
+        dispatch.FUSED_INGEST,
+        dispatch.FUSED_MIN_BATCH,
         dispatch.THRESHOLDS_FILE,
         dispatch.THRESHOLDS_SOURCE,
     )
@@ -28,6 +30,8 @@ def restore_dispatch_globals():
         dispatch.HIGH_CARDINALITY_KERNEL,
         dispatch.SPARSE_DENSITY_CROSSOVER,
         dispatch.SPARSE_KERNEL,
+        dispatch.FUSED_INGEST,
+        dispatch.FUSED_MIN_BATCH,
         dispatch.THRESHOLDS_FILE,
         dispatch.THRESHOLDS_SOURCE,
     ) = saved
@@ -41,12 +45,17 @@ def test_thresholds_file_overrides_baked_constants(
         "sort_min_metrics": 512,
         "high_cardinality_kernel": "sortscan",
         "pallas_single_metric": False,
+        # a capture that ranks the fused kernel slower pins it off — the
+        # sortscan assertions below depend on that (otherwise choose
+        # returns "fused" at >= sort_min_metrics on TPU)
+        "fused_ingest": False,
     }
     path = tmp_path / "dispatch_thresholds.json"
     path.write_text(json.dumps(table))
     dispatch.THRESHOLDS_FILE = str(path)
     dispatch._load_thresholds()
     assert dispatch.SORT_MIN_METRICS == 512
+    assert dispatch.FUSED_INGEST is False
     assert dispatch.THRESHOLDS_SOURCE == "TPU_CAPTURE_test"
     # the policy immediately reflects the overrides
     assert dispatch.choose_ingest_path(1, 8193, "tpu") == "scatter"

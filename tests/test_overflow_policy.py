@@ -33,13 +33,17 @@ def baked_thresholds():
     from loghisto_tpu.ops import dispatch
 
     saved = (dispatch.SORT_MIN_METRICS, dispatch.PALLAS_SINGLE_METRIC,
-             dispatch.HIGH_CARDINALITY_KERNEL)
+             dispatch.HIGH_CARDINALITY_KERNEL, dispatch.FUSED_INGEST,
+             dispatch.FUSED_MIN_BATCH)
     dispatch.SORT_MIN_METRICS = 4096
     dispatch.PALLAS_SINGLE_METRIC = True
     dispatch.HIGH_CARDINALITY_KERNEL = "sort"
+    dispatch.FUSED_INGEST = True
+    dispatch.FUSED_MIN_BATCH = 1 << 17
     yield
     (dispatch.SORT_MIN_METRICS, dispatch.PALLAS_SINGLE_METRIC,
-     dispatch.HIGH_CARDINALITY_KERNEL) = saved
+     dispatch.HIGH_CARDINALITY_KERNEL, dispatch.FUSED_INGEST,
+     dispatch.FUSED_MIN_BATCH) = saved
 
 
 def test_choose_ingest_path_table(baked_thresholds):
@@ -48,7 +52,10 @@ def test_choose_ingest_path_table(baked_thresholds):
     # range, sort-dedup wins back high metric cardinality on TPU
     assert choose_ingest_path(1, 8193, "tpu") == "pallas"
     assert choose_ingest_path(128, 8193, "tpu") == "scatter"
-    assert choose_ingest_path(10_000, 8193, "tpu") == "sort"
+    # r13: the fused sample->scatter kernel is the high-cardinality pick
+    # on TPU; resolve degrades it to HIGH_CARDINALITY_KERNEL when
+    # fused_ingest_incapability names a blocker
+    assert choose_ingest_path(10_000, 8193, "tpu") == "fused"
     assert choose_ingest_path(1, 8193, "cpu") == "scatter"
     assert choose_ingest_path(10_000, 8193, "cpu") == "scatter"
 
@@ -56,8 +63,13 @@ def test_choose_ingest_path_table(baked_thresholds):
 def test_resolve_ingest_path_guards_sort_shape(baked_thresholds):
     from loghisto_tpu.ops.dispatch import resolve_ingest_path
 
-    # auto on TPU at high cardinality picks sort when the combined int32
-    # cell key fits, and falls back to scatter when it would wrap
+    # auto on TPU at high cardinality picks the fused kernel when the
+    # batch bound is known to amortize its preprocess; with the bound
+    # unknown it degrades to sort (when the combined int32 cell key
+    # fits), and falls back to scatter when that would wrap
+    assert resolve_ingest_path(
+        "auto", 10_000, 8193, "tpu", batch_size=1 << 20
+    ) == "fused"
     assert resolve_ingest_path("auto", 10_000, 8193, "tpu") == "sort"
     assert resolve_ingest_path("auto", 300_000, 8193, "tpu") == "scatter"
     # an explicit unsupportable choice fails at selection time, not as a
