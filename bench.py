@@ -649,6 +649,36 @@ def main() -> None:
         print(f"bench: fused-ingest stage failed: {e}", file=sys.stderr)
     ready11.set()
 
+    # paged-storage headline (benchmarks/paged_store.py has the full
+    # three-config wire comparison and the 1M-row HBM math): commit H2D
+    # bytes per interval under the r14 paged backend at the largest wire
+    # point, and live metric rows per GiB of pool+table HBM from measured
+    # page occupancy.  Wire bytes come from transport accounting, not
+    # wall clocks, so interpret-mode CPU runs report the same numbers a
+    # TPU capture would; the row count shrinks off-TPU to bound runtime.
+    ready12 = _start_watchdog(300.0, on_timeout=lambda: print(
+        json.dumps(result), flush=True
+    ))
+    try:
+        from benchmarks.paged_store import run as paged_run
+
+        if platform == "tpu":
+            pg = paged_run(wire_rows=(10_000, 100_000))
+        else:
+            pg = paged_run(wire_rows=(25_000,), occupancy_rows=25_000)
+        result["paged_h2d_bytes_per_interval"] = (
+            pg["paged_h2d_bytes_per_interval"]
+        )
+        result["paged_h2d_reduction"] = pg["h2d_reduction"]
+        result["max_live_rows_per_gib"] = pg["max_live_rows_per_gib"]
+        result["paged_1m_rows_fit_one_chip"] = (
+            pg["one_million_rows"]["fits_one_chip"]
+        )
+        result["paged_suspect"] = pg["suspect"]
+    except Exception as e:  # never let the extra metric kill the bench
+        print(f"bench: paged-storage stage failed: {e}", file=sys.stderr)
+    ready12.set()
+
     print(json.dumps(result))
 
 

@@ -79,6 +79,13 @@ def commit_incompatibility(aggregator, wheel) -> Optional[str]:
     program, or None when it can.  The fused program scatters ONE cell
     array into both carries, so the pair must agree on row ids (shared
     registry) and bucket geometry (bucket_limit/precision)."""
+    if getattr(aggregator, "paged", None) is not None:
+        return (
+            "paged storage: the fused commit program scatters into the "
+            "dense [M, B] accumulator carry, which a paged aggregator "
+            "does not keep (its pool + page table ARE the accumulator); "
+            "the fan-out commit merges through the paged triple path"
+        )
     if aggregator.registry is not wheel.registry:
         return "aggregator and wheel use different registries"
     if aggregator.config.bucket_limit != wheel.config.bucket_limit:

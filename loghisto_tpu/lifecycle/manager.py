@@ -71,6 +71,15 @@ class LifecycleManager:
                 "lifecycle needs a retention wheel: activity tracking and"
                 " eviction ride the fused interval commit"
             )
+        if getattr(aggregator, "paged", None) is not None:
+            raise ValueError(
+                "lifecycle manager is dense-only: its fold/compact device"
+                " programs thread the dense [M, B] accumulator as a"
+                " donated carry, which a paged aggregator does not keep."
+                " Paged survivor repack composes at the PagedStore API"
+                " instead (release_rows / apply_permutation /"
+                " fold_rows_into return pages to the free pool)"
+            )
         self.aggregator = aggregator
         self.wheel = wheel
         self.config = config

@@ -55,7 +55,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from loghisto_tpu.ops.lifecycle import _sanitize_perm
-from loghisto_tpu.ops.pallas_kernels import _on_tpu
+from loghisto_tpu.ops.backend import default_interpret
 
 ROWS_TILE = 8  # f32/int32 sublane tile, same as the window merge
 
@@ -156,7 +156,7 @@ def divergence_pallas(cdf, counts, prof, w, interpret=None):
     (padded rows are sliced off) and the per-row math is the SAME
     function the jnp tier runs, so results are bit-identical."""
     if interpret is None:
-        interpret = not _on_tpu()
+        interpret = default_interpret()
     m, b = cdf.shape
     m_pad = (m + ROWS_TILE - 1) // ROWS_TILE * ROWS_TILE
     if m_pad != m:

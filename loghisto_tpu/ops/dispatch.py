@@ -107,6 +107,24 @@ SPARSE_DENSITY_CROSSOVER = 0.5
 # flips this.
 SPARSE_KERNEL = "jnp"
 
+# Whether storage="auto" considers the r14 paged bucket backend
+# (ops/paged_store.py + loghisto_tpu/paging.py): the dense [M, B]
+# accumulator replaced by a page pool + page table so HBM and commit
+# H2D track OCCUPIED buckets.  Auto only switches at high metric
+# cardinality — below the crossover the dense tensor fits HBM trivially
+# and its donated in-place commit beats the translate step's host work.
+PAGED_STORAGE = True
+
+# Metric-row crossover for storage="auto": the dense accumulator at
+# M=2^16 x B=8193 x 4B is ~2.1 GiB of HBM and the page pool wins
+# outright on sparse occupancy (PAGED_STORE_r14); below it dense wins
+# on simplicity.  Baked FALLBACK, capture-overridable like the rest.
+PAGED_MIN_METRICS = 1 << 16
+
+# Buckets per pool page; mirrored from ops/paged_store.PAGE_SIZE
+# without importing jax (this module must stay importable without jax).
+PAGE_SIZE = 256
+
 # Capture-derived threshold table (VERDICT r2 item 7): refreshing the
 # dispatch policy after a hardware capture is a committed JSON (emitted
 # by ``benchmarks/analyze_capture.py --emit-thresholds``), not a code
@@ -124,6 +142,7 @@ def _load_thresholds() -> None:
     global HIGH_CARDINALITY_KERNEL, FUSED_COMMIT
     global SPARSE_DENSITY_CROSSOVER, SPARSE_KERNEL
     global FUSED_INGEST, FUSED_MIN_BATCH
+    global PAGED_STORAGE, PAGED_MIN_METRICS
     try:
         with open(THRESHOLDS_FILE) as f:
             table = _json.load(f)
@@ -168,6 +187,14 @@ def _load_thresholds() -> None:
     fmb = table.get("fused_min_batch")
     if isinstance(fmb, int) and not isinstance(fmb, bool) and fmb >= 1:
         FUSED_MIN_BATCH = fmb
+        applied = True
+    pst = table.get("paged_storage")
+    if isinstance(pst, bool):
+        PAGED_STORAGE = pst
+        applied = True
+    pmm = table.get("paged_min_metrics")
+    if isinstance(pmm, int) and not isinstance(pmm, bool) and pmm > 1:
+        PAGED_MIN_METRICS = pmm
         applied = True
     if applied:  # never cite a table that contributed nothing
         THRESHOLDS_SOURCE = str(table.get("source", THRESHOLDS_FILE))
@@ -405,6 +432,96 @@ def mesh_commit_incapability(mesh, num_metrics=None) -> str | None:
             f"the {n_metric}-way metric axis"
         )
     return None
+
+
+def paged_storage_incapability(
+    num_metrics: int,
+    num_buckets: int | None = None,
+    mesh: bool = False,
+    transport: str = "sparse",
+    crossover: bool = True,
+) -> str | None:
+    """Why a configuration genuinely cannot (or should not) run the r14
+    paged bucket backend, as a human-readable reason string — or None
+    when it can.  Same contract as ``fused_ingest_incapability``:
+    storage="auto" degrades silently on a reason, an EXPLICIT
+    ``storage="paged"`` surfaces the same string in its raise.
+
+    ``crossover=False`` skips the metric-cardinality check — that is
+    capacity policy, not correctness, and an explicit selection is
+    allowed to page a small deployment (the tests do).
+    """
+    if mesh:
+        return (
+            "mesh shape: paged storage does not run on a sharded mesh "
+            "(the page pool is a single-device arena; the page table's "
+            "slot ids are meaningless across shards — the sharded path "
+            "keeps its dense row-sharded accumulator)"
+        )
+    if transport not in ("sparse", "auto"):
+        return (
+            f"transport: paged storage commits through the packed "
+            f"[n,3] sparse-triple fold (transport='sparse'); "
+            f"transport={transport!r} ships whole batches with no host "
+            "fold, so there is no translate step to route cells through "
+            "the page table"
+        )
+    if num_buckets is not None and num_buckets < PAGE_SIZE:
+        return (
+            f"bucket axis: num_buckets={num_buckets} is smaller than "
+            f"one {PAGE_SIZE}-bucket page — the dense row is already "
+            "cheaper than any page table"
+        )
+    if crossover and num_metrics < PAGED_MIN_METRICS:
+        return (
+            f"below crossover: {num_metrics} metric rows — the dense "
+            f"accumulator fits HBM trivially below {PAGED_MIN_METRICS} "
+            "rows and its donated in-place commit wins (PAGED_STORE_r14)"
+        )
+    return None
+
+
+def resolve_storage_path(
+    storage: str,
+    num_metrics: int,
+    num_buckets: int,
+    platform: str,
+    mesh: bool = False,
+    transport: str = "sparse",
+) -> tuple[str, str | None]:
+    """Resolve the accumulator storage backend: "dense" (the donated
+    [M, B] tensor) or "paged" (page pool + page table + per-row codecs,
+    r14).  Mirrors ``resolve_commit_path``: "auto" degrades to dense
+    with the reason (returned, so TPUAggregator can surface it as
+    ``storage_reason``), an explicit "paged" a capability blocker
+    invalidates raises the same string, and unknown names raise.
+
+    Returns ``(resolved, reason)`` — reason is None unless auto
+    declined paged.
+    """
+    del platform  # both backends run on every platform (interpret tier)
+    if storage == "auto":
+        if not PAGED_STORAGE:
+            return "dense", "paged storage disabled by threshold table"
+        reason = paged_storage_incapability(
+            num_metrics, num_buckets, mesh=mesh, transport=transport
+        )
+        if reason is not None:
+            return "dense", reason
+        return "paged", None
+    if storage not in ("dense", "paged"):
+        raise ValueError(
+            f"unknown storage {storage!r}: expected 'auto', 'dense', or "
+            "'paged'"
+        )
+    if storage == "paged":
+        reason = paged_storage_incapability(
+            num_metrics, num_buckets, mesh=mesh, transport=transport,
+            crossover=False,
+        )
+        if reason is not None:
+            raise ValueError(f"paged storage unavailable: {reason}")
+    return storage, None
 
 
 def resolve_commit_path(

@@ -47,7 +47,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from loghisto_tpu.ops.commit import DROP_ID
-from loghisto_tpu.ops.pallas_kernels import _on_tpu
+from loghisto_tpu.ops.backend import default_interpret
 
 
 @functools.lru_cache(maxsize=None)
@@ -160,7 +160,7 @@ def compact_rows_pallas(
     Empty rows (negative / DROP sentinel) clamp to row 0 for the fetch
     and are zeroed in the kernel."""
     if interpret is None:
-        interpret = not _on_tpu()
+        interpret = default_interpret()
     m, b = arr.shape
     n = perm.shape[0]
     # sanitize the sentinel into -1 so the kernel's sign test works for

@@ -29,8 +29,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from loghisto_tpu.config import PRECISION
-# shared backend probe (ops/backend.py); the `_on_tpu` name stays
-# importable — window/lifecycle/anomaly/multirow all read it from here
+# shared backend probe (ops/backend.py); every kernel module now calls
+# backend.default_interpret() directly (r14 probe dedup) — the `_on_tpu`
+# alias stays importable for external callers only
+from loghisto_tpu.ops.backend import default_interpret
 from loghisto_tpu.ops.backend import on_tpu as _on_tpu  # noqa: F401
 from loghisto_tpu.ops.ingest import bucket_indices
 
@@ -93,7 +95,7 @@ def pallas_histogram_row(
     ids).  Returns the updated row.
     """
     if interpret is None:
-        interpret = not _on_tpu()
+        interpret = default_interpret()
     b = acc_row.shape[0]
     h = (b + LANES - 1) // LANES
     b_pad = h * LANES
@@ -203,7 +205,7 @@ def pallas_row_ingest_batch(
     lets ``ingest_path="auto"``/"pallas" reach the measured-fastest M=1
     kernel through the same dispatch table as every other path."""
     if interpret is None:
-        interpret = not _on_tpu()
+        interpret = default_interpret()
     if acc.ndim != 2 or acc.shape[0] != 1:
         raise ValueError(
             f"pallas row path needs a single-metric [1, B] accumulator; "

@@ -39,7 +39,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from loghisto_tpu.config import PRECISION
 from loghisto_tpu.ops.ingest import bucket_indices
-from loghisto_tpu.ops.pallas_kernels import LANES, SAMPLE_TILE, _on_tpu
+from loghisto_tpu.ops.backend import default_interpret
+from loghisto_tpu.ops.pallas_kernels import LANES, SAMPLE_TILE
 
 
 def preprocess(
@@ -153,7 +154,7 @@ def make_multirow_ingest(
             f"num_metrics={num_metrics} must divide by rows_tile={rows_tile}"
         )
     if interpret is None:
-        interpret = not _on_tpu()
+        interpret = default_interpret()
     num_buckets = 2 * bucket_limit + 1
     h = (num_buckets + LANES - 1) // LANES
     b_pad = h * LANES

@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from loghisto_tpu.ops.backend import on_tpu as _on_tpu
+from loghisto_tpu.ops.backend import default_interpret
 from loghisto_tpu.ops.ingest import sanitize_ids
 
 # Triples per Pallas grid step: small enough that the SMEM operand
@@ -142,7 +142,7 @@ def pallas_sparse_ingest(
             pltpu.SemaphoreType.DMA(()),
         ],
         input_output_aliases={3: 0},
-        interpret=not _on_tpu(),
+        interpret=default_interpret(),
     )(ids, idx, weights, acc)
 
 

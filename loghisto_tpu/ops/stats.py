@@ -88,6 +88,76 @@ def bucket_representatives(
     return decompress(idx, precision).astype(dtype)
 
 
+def sparse_cells_stats(
+    rows: np.ndarray,
+    dense_idx: np.ndarray,
+    counts: np.ndarray,
+    num_metrics: int,
+    ps: np.ndarray,
+    bucket_limit: int,
+    precision: int = PRECISION,
+) -> dict[str, np.ndarray]:
+    """dense_stats_np over a sparse cell list: O(occupied cells) host
+    work, never a dense ``[M, B]`` materialization — the collect() tier
+    of the paged backend (loghisto_tpu/paging.py).
+
+    Args:
+      rows / dense_idx / counts: parallel cell arrays — metric row,
+        dense-axis bucket index (codec bucket + bucket_limit), int64
+        count.  Duplicate (row, bucket) cells are allowed and fold.
+
+    Selection is identical to dense_stats_np (first bucket where
+    float(cum)/float(total) >= p over int64-exact cumsums; endpoints
+    are the first/last populated bucket), so percentiles of a sparse
+    view are BIT-IDENTICAL to the dense oracle over the same histogram.
+    Sums reduce in occupied-bucket order, which can differ from the
+    dense matvec in the final float64 ulp.
+    """
+    rows = np.asarray(rows, dtype=np.int64)
+    dense_idx = np.asarray(dense_idx, dtype=np.int64)
+    cell_counts = np.asarray(counts, dtype=np.int64)
+    ps = np.asarray(ps, dtype=np.float64)
+    m, p_n = int(num_metrics), len(ps)
+    out_counts = np.zeros(m, dtype=np.int64)
+    out_sums = np.zeros(m, dtype=np.float64)
+    out_pct = np.zeros((m, p_n), dtype=np.float64)
+    if not len(rows):
+        return {
+            "counts": out_counts, "sums": out_sums, "percentiles": out_pct,
+        }
+    # fold duplicates and order cells by (row, bucket) in one pass
+    order = np.lexsort((dense_idx, rows))
+    rows, dense_idx, cell_counts = (
+        rows[order], dense_idx[order], cell_counts[order]
+    )
+    keys = rows * (2 * bucket_limit + 2) + dense_idx
+    uniq, inverse = np.unique(keys, return_inverse=True)
+    folded = np.zeros(len(uniq), dtype=np.int64)
+    np.add.at(folded, inverse, cell_counts)
+    first = np.searchsorted(keys, uniq, side="left")
+    rows, dense_idx, cell_counts = rows[first], dense_idx[first], folded
+
+    reps = decompress_np(dense_idx - bucket_limit, precision)
+    starts = np.searchsorted(rows, np.arange(m), side="left")
+    ends = np.searchsorted(rows, np.arange(m), side="right")
+    for r in range(m):
+        lo, hi = starts[r], ends[r]
+        if lo == hi:
+            continue
+        c = cell_counts[lo:hi]
+        cdf = np.cumsum(c)
+        total = cdf[-1]
+        out_counts[r] = total
+        out_sums[r] = np.dot(reps[lo:hi], c.astype(np.float64))
+        cdfn = cdf.astype(np.float64) / float(total)
+        pos = np.minimum(
+            np.searchsorted(cdfn, ps, side="left"), hi - lo - 1
+        )
+        idx = np.where(ps <= 0, 0, np.where(ps >= 1, hi - lo - 1, pos))
+        out_pct[r] = reps[lo:hi][idx]
+    return {"counts": out_counts, "sums": out_sums, "percentiles": out_pct}
+
+
 def dense_stats_np(
     acc: np.ndarray,
     ps: np.ndarray,

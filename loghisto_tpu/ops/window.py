@@ -41,7 +41,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from loghisto_tpu.config import PRECISION
-from loghisto_tpu.ops.pallas_kernels import _on_tpu
+from loghisto_tpu.ops.backend import default_interpret
 from loghisto_tpu.ops.stats import dense_cdf, dense_stats
 
 ROWS_TILE = 8  # int32 sublane tile
@@ -81,7 +81,7 @@ def window_merge_pallas(
     so each [ROWS_TILE, B] output block is written to HBM exactly once
     however long the window is."""
     if interpret is None:
-        interpret = not _on_tpu()
+        interpret = default_interpret()
     slots, m, b = ring.shape
     m_pad = (m + ROWS_TILE - 1) // ROWS_TILE * ROWS_TILE
     if m_pad != m:

@@ -1,0 +1,783 @@
+"""Host-side paged bucket storage: page tables, on-demand allocation,
+variable-resolution codecs, and the spill-to-overflow policy.
+
+``PagedStore`` is the storage="paged" backend behind TPUAggregator: it
+owns the device page pool (ops/paged_store.py), the host page table,
+and the per-metric codec choices, and exposes the same exactness
+contract as the dense accumulator — every count lands somewhere
+accountable (a mapped page, the overflow row, or the exact host spill),
+never silently dropped.
+
+Variable-resolution codecs
+--------------------------
+
+Each metric row stores its buckets under one of three layouts on a
+STORAGE bucket axis that the codec maps to/from the native log-bucket
+axis (dense index d in [0, B), native codec bucket d - bucket_limit):
+
+  * ``dense``     — identity: native resolution, exact.  Rows whose
+    occupied span fits a few pages keep full precision for free.
+  * ``loglinear`` — circllhist-style coarsening ("A Log-Linear
+    Histogram Data Structure for IT Infrastructure Monitoring",
+    PAPERS.md): ``factor`` adjacent native log buckets merge into one
+    storage bucket, sign-mirrored so bucket 0 stays centered.  Native
+    buckets are already log-spaced, so the merged grid is linear in
+    log space and the representative error is bounded by the half-chunk
+    ratio: |err| <= (e^(ceil(factor/2)/precision) - 1) * (|v| + 1).
+  * ``polytail``  — polynomial tail compression ("Polynomial Histograms
+    for Memory-Efficient Representation of Long-tailed System
+    Distributions", PAPERS.md): exact inside |bucket| <=
+    body_halfwidth, beyond it chunk widths grow quadratically
+    (1, 4, 9, ... native buckets) up to the width cap derived from
+    ``tail_rel_error``, so the long sparse tail collapses to a few
+    storage buckets while the tail percentile error stays bounded by
+    construction.
+
+All three reduce to a pair of LUTs (encode: native dense index ->
+storage index; decode: storage index -> representative native dense
+index), so translation is one vectorized NumPy gather per commit and
+the device decode is one scatter through the traced LUT.  The
+``max_halfwidth`` of a codec gives its asserted error bound
+(tests/test_paged_store.py's parity oracle): a dense-codec row is
+BIT-IDENTICAL to the dense accumulator; a compressed row's percentiles
+are within ``(e^((max_halfwidth + 0.5)/precision) - 1)`` relative.
+
+Allocation & spill policy
+-------------------------
+
+translate() sees every cell of a commit (the sparse transport already
+folds batches to packed triples on host), so allocation is a host
+decision with no device round trip: unmapped (row, page) pairs take
+slots from the free list; when the pool saturates, cells re-route to
+the ``overflow_row`` (whose pages are reserved at construction — the
+catch-all row can never itself fail to allocate) under its coarse
+codec; with no overflow row configured they fold into the exact host
+spill dict.  Lifecycle composition: ``release_rows`` returns a victim's
+pages to the free list (after the caller folds its counts), and
+``apply_permutation`` repacks survivors by permuting page-table ROWS —
+an O(M) host copy with zero device data movement, because pool pages
+are position-independent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from loghisto_tpu.config import PRECISION
+
+CODEC_DENSE = "dense"
+CODEC_LOGLINEAR = "loglinear"
+CODEC_POLYTAIL = "polytail"
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketCodec:
+    """One storage layout: a pair of LUTs plus its error bound.
+
+    enc_lut: int32 [B] — native dense index -> storage index.
+    dec_lut: int32 [S] — storage index -> representative native dense
+      index (injective: distinct storage buckets decode to distinct
+      native buckets, so device expansion is an exact scatter).
+    max_halfwidth: worst-case distance (native buckets) between a
+      bucket and its chunk representative — 0 for the identity codec.
+    """
+
+    name: str
+    enc_lut: np.ndarray
+    dec_lut: np.ndarray
+    max_halfwidth: int
+
+    @property
+    def storage_buckets(self) -> int:
+        return len(self.dec_lut)
+
+    def max_rel_error(self, precision: int = PRECISION) -> float:
+        """Bounded representative error: |decode(encode(v)) - v| <=
+        max_rel_error * (|v| + 1).  The +0.5 absorbs the native codec's
+        own rounding so the bound is safe end to end."""
+        if self.max_halfwidth == 0:
+            return 0.0
+        return math.exp((self.max_halfwidth + 0.5) / precision) - 1.0
+
+
+def _codec_from_chunks(name: str, chunk_of: np.ndarray) -> BucketCodec:
+    """Build a codec from a per-native-bucket chunk id array [B]: each
+    chunk becomes one storage bucket whose representative is the
+    chunk's center native bucket."""
+    chunks, enc = np.unique(chunk_of, return_inverse=True)
+    enc = enc.astype(np.int32)
+    dec = np.zeros(len(chunks), dtype=np.int32)
+    width = 0
+    for s in range(len(chunks)):
+        members = np.nonzero(enc == s)[0]
+        dec[s] = members[(len(members) - 1) // 2]
+        width = max(width, int(members[-1] - dec[s]), int(dec[s] - members[0]))
+    return BucketCodec(
+        name=name, enc_lut=enc, dec_lut=dec, max_halfwidth=width
+    )
+
+
+def dense_codec(num_buckets: int) -> BucketCodec:
+    idx = np.arange(num_buckets, dtype=np.int32)
+    return BucketCodec(
+        name=CODEC_DENSE, enc_lut=idx, dec_lut=idx.copy(), max_halfwidth=0
+    )
+
+
+def loglinear_codec(bucket_limit: int, factor: int) -> BucketCodec:
+    """Sign-mirrored coarsening: native codec bucket c chunks to
+    sign(c) * (|c| // factor) — bucket 0's chunk stays centered on
+    zero, so tiny values keep their sign and near-zero magnitude."""
+    if factor < 2:
+        raise ValueError(f"loglinear factor must be >= 2, got {factor}")
+    c = np.arange(-bucket_limit, bucket_limit + 1, dtype=np.int64)
+    chunk = np.sign(c) * (np.abs(c) // factor)
+    return _codec_from_chunks(CODEC_LOGLINEAR, chunk)
+
+
+def polytail_codec(
+    bucket_limit: int,
+    body_halfwidth: int,
+    tail_rel_error: float,
+    precision: int = PRECISION,
+) -> BucketCodec:
+    """Exact body, quadratically growing tail chunks capped so the
+    tail representative error stays <= tail_rel_error."""
+    if not 0 < body_halfwidth < bucket_limit:
+        raise ValueError(
+            f"body_halfwidth must be in (0, {bucket_limit}); "
+            f"got {body_halfwidth}"
+        )
+    if tail_rel_error <= 0:
+        raise ValueError(f"tail_rel_error must be > 0, got {tail_rel_error}")
+    # widest admissible chunk: halfwidth w/2 must satisfy
+    # e^((w/2 + 0.5)/precision) - 1 <= tail_rel_error
+    cap = max(2, int(2 * (precision * math.log1p(tail_rel_error) - 0.5)))
+    c = np.arange(-bucket_limit, bucket_limit + 1, dtype=np.int64)
+    mag = np.abs(c)
+    # tail chunk boundaries: widths 1, 4, 9, ... capped at `cap`
+    bounds = [body_halfwidth]
+    k = 1
+    while bounds[-1] < bucket_limit:
+        bounds.append(bounds[-1] + min(cap, k * k))
+        k += 1
+    bounds = np.asarray(bounds, dtype=np.int64)
+    # body buckets chunk to themselves; tail buckets to their band
+    tail_band = np.searchsorted(bounds, mag, side="left")
+    chunk = np.where(
+        mag <= body_halfwidth, c, np.sign(c) * (bucket_limit + tail_band)
+    )
+    return _codec_from_chunks(CODEC_POLYTAIL, chunk)
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedStoreConfig:
+    """Knobs for the paged backend.
+
+    pool_pages: page-pool capacity (slot 0 is the reserved zero page).
+      The default 4096 x 256 buckets = 4 MiB of pool — at ~2 pages per
+      live sparse row that is ~2k rows; size it to the deployment
+      (benchmarks/paged_store.py demonstrates the 1M-row config).
+    codec: "auto" picks per row by occupancy (choose_codec below);
+      naming one of dense/loglinear/polytail pins every row.
+    dense_page_budget: auto keeps a row on the exact dense codec while
+      its occupied span fits this many pages.
+    tail_occupancy: auto prefers polytail when at least this fraction
+      of a row's occupied buckets sit beyond body_halfwidth.
+    """
+
+    page_size: int = 256
+    pool_pages: int = 4096
+    codec: str = "auto"
+    loglinear_factor: int = 4
+    body_halfwidth: int = 1024
+    tail_rel_error: float = 0.10
+    dense_page_budget: int = 4
+    tail_occupancy: float = 0.5
+    overflow_row: Optional[int] = None
+
+    def __post_init__(self):
+        if self.codec not in (
+            "auto", CODEC_DENSE, CODEC_LOGLINEAR, CODEC_POLYTAIL
+        ):
+            raise ValueError(f"unknown paged codec {self.codec!r}")
+        if self.dense_page_budget < 1:
+            raise ValueError(
+                f"dense_page_budget must be >= 1, got {self.dense_page_budget}"
+            )
+
+
+class PagedStore:
+    """Paged accumulator backend: device pool + host page table + codecs.
+
+    Thread safety follows the aggregator's locking: every mutating call
+    happens under the owner's _dev_lock; the internal lock only guards
+    the host table for concurrent read-side queries.
+    """
+
+    def __init__(
+        self,
+        num_metrics: int,
+        bucket_limit: int,
+        precision: int = PRECISION,
+        config: PagedStoreConfig = PagedStoreConfig(),
+        kernel: str = "jnp",
+        mesh=None,
+    ):
+        from loghisto_tpu.ops.paged_store import validate_pool_shape
+
+        if mesh is not None:
+            raise ValueError(
+                "paged storage is single-device for now: the page pool "
+                "is not metric-row-sharded (ops/dispatch."
+                "paged_storage_incapability)"
+            )
+        validate_pool_shape(config.pool_pages, config.page_size)
+        self.config = config
+        self.bucket_limit = int(bucket_limit)
+        self.precision = int(precision)
+        self.num_buckets = 2 * self.bucket_limit + 1
+        self.num_metrics = int(num_metrics)
+        self._lock = threading.Lock()
+
+        # codec table: ids are indices into _codecs; rows start
+        # unassigned (-1) and get a codec on first touch
+        self._codecs: List[BucketCodec] = [
+            dense_codec(self.num_buckets),
+            loglinear_codec(self.bucket_limit, config.loglinear_factor),
+            polytail_codec(
+                self.bucket_limit,
+                # the config default is tuned for the 4096-limit codec;
+                # clamp for narrow histograms so construction never fails
+                min(config.body_halfwidth, max(1, self.bucket_limit // 2)),
+                config.tail_rel_error,
+                self.precision,
+            ),
+        ]
+        self._codec_ids = {c.name: i for i, c in enumerate(self._codecs)}
+        # stacked LUTs for one-gather translation across mixed codecs
+        self._enc = np.stack([c.enc_lut for c in self._codecs])
+        self.row_codec = np.full(self.num_metrics, -1, dtype=np.int8)
+
+        # page table: pages_per_row sized for the WIDEST codec (dense)
+        page = config.page_size
+        self.pages_per_row = -(-self.num_buckets // page)
+        self.page_table = np.full(
+            (self.num_metrics, self.pages_per_row), -1, dtype=np.int32
+        )
+        self._free: List[int] = list(
+            range(config.pool_pages - 1, 0, -1)
+        )  # slot 0 reserved zero page
+
+        import jax.numpy as jnp
+
+        from loghisto_tpu.ops.paged_store import make_paged_commit_fn
+
+        self._pool = jnp.zeros(
+            (config.pool_pages, page), dtype=jnp.int32
+        )
+        self._commit = make_paged_commit_fn(kernel)
+
+        # exact host spill for cells no page can hold (pool saturated
+        # and the overflow row unavailable): {(row, native dense idx):
+        # int count} — int64-exact at any magnitude
+        self._host_spill: Dict[Tuple[int, int], int] = {}
+
+        # accounting
+        self.commits = 0
+        self.h2d_bytes = 0
+        self.last_h2d_bytes = 0
+        self.allocated_pages = 0
+        self.released_pages = 0
+        self.overflowed_cells = 0
+        self.spilled_cells = 0
+
+        if config.overflow_row is not None:
+            self._reserve_overflow_pages(config.overflow_row)
+
+    # -- codec selection ------------------------------------------------ #
+
+    def _choose_codec(self, dense_idx: np.ndarray) -> int:
+        """Pick a codec for a row from its first-touch occupied native
+        buckets: exact dense while the span fits the page budget, then
+        polytail for tail-heavy rows, loglinear otherwise."""
+        cfg = self.config
+        if cfg.codec != "auto":
+            return self._codec_ids[cfg.codec]
+        page = cfg.page_size
+        span_pages = len(np.unique(dense_idx // page))
+        if span_pages <= cfg.dense_page_budget:
+            return self._codec_ids[CODEC_DENSE]
+        tail = np.abs(dense_idx - self.bucket_limit) > cfg.body_halfwidth
+        if tail.mean() >= cfg.tail_occupancy:
+            return self._codec_ids[CODEC_POLYTAIL]
+        return self._codec_ids[CODEC_LOGLINEAR]
+
+    def _assign_codecs(self, rows: np.ndarray, dense_idx: np.ndarray) -> None:
+        new_rows = np.unique(rows[self.row_codec[rows] < 0])
+        for r in new_rows:
+            mask = rows == r
+            self.row_codec[r] = self._choose_codec(dense_idx[mask])
+
+    def set_row_codec(self, row: int, name: str) -> None:
+        """Pin a row's codec explicitly (checkpoint restore, tests).
+        Only legal before the row holds data under a different codec."""
+        want = self._codec_ids[name]
+        if self.row_codec[row] >= 0 and self.row_codec[row] != want:
+            if np.any(self.page_table[row] >= 0):
+                raise ValueError(
+                    f"row {row} already holds data under codec "
+                    f"{self._codecs[self.row_codec[row]].name!r}"
+                )
+        self.row_codec[row] = want
+
+    # -- allocation ----------------------------------------------------- #
+
+    def _reserve_overflow_pages(self, row: int) -> None:
+        """The overflow row must never itself fail to allocate: map its
+        (coarse-codec) pages eagerly at construction."""
+        self.row_codec[row] = self._codec_ids[CODEC_LOGLINEAR]
+        codec = self._codecs[self.row_codec[row]]
+        page = self.config.page_size
+        n_pages = -(-codec.storage_buckets // page)
+        for p in range(n_pages):
+            if self.page_table[row, p] < 0:
+                if not self._free:
+                    raise ValueError(
+                        "pool too small to reserve the overflow row's "
+                        f"{n_pages} pages; raise pool_pages"
+                    )
+                self.page_table[row, p] = self._free.pop()
+                self.allocated_pages += 1
+
+    def _alloc(self, row: int, page_idx: int) -> int:
+        """One page allocation; returns the slot or -1 when saturated."""
+        if not self._free:
+            return -1
+        slot = self._free.pop()
+        self.page_table[row, page_idx] = slot
+        self.allocated_pages += 1
+        return slot
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def occupied_pages(self) -> int:
+        return self.config.pool_pages - 1 - len(self._free)
+
+    def hbm_bytes(self) -> int:
+        """Device-resident footprint: the pool plus the (host) table's
+        device-mirrorable size — what the 1M-row budget is measured
+        against (benchmarks/paged_store.py)."""
+        pool = self.config.pool_pages * self.config.page_size * 4
+        table = self.page_table.size * 4
+        return pool + table
+
+    # -- commit --------------------------------------------------------- #
+
+    def translate(
+        self, packed: np.ndarray
+    ) -> Tuple[np.ndarray, int, int]:
+        """Rewrite packed (row, codec_bucket, count) triples into
+        translated (slot, offset, count) triples against the page
+        table, allocating pages on demand and applying the spill
+        policy.  Returns (device_triples, applied_count_total,
+        spill_count_total); counts routed to the host spill are applied
+        exactly there before this returns."""
+        rows = packed[:, 0].astype(np.int64)
+        keep = (rows >= 0) & (rows < self.num_metrics)
+        rows = rows[keep]
+        if not len(rows):
+            return np.empty((0, 3), dtype=np.int32), 0, 0
+        L = self.bucket_limit
+        dense_idx = (
+            np.clip(packed[keep, 1].astype(np.int64), -L, L) + L
+        )
+        weights = packed[keep, 2].astype(np.int64)
+
+        self._assign_codecs(rows, dense_idx)
+        storage = self._enc[self.row_codec[rows], dense_idx]
+        page = self.config.page_size
+        page_idx = storage // page
+        offs = (storage % page).astype(np.int32)
+
+        slots = self.page_table[rows, page_idx]
+        missing = slots < 0
+        if missing.any():
+            # allocate each unique unmapped (row, page) once
+            pairs = np.unique(
+                np.stack([rows[missing], page_idx[missing]], axis=1), axis=0
+            )
+            for r, p in pairs:
+                self._alloc(int(r), int(p))
+            slots = self.page_table[rows, page_idx]
+
+        mapped = slots >= 0
+        out_rows, out_offs, out_w = slots, offs, weights
+        spilled_total = 0
+        if not mapped.all():
+            # pool saturated: overflow-row redirect, else exact host spill
+            um_rows = rows[~mapped]
+            um_idx = dense_idx[~mapped]
+            um_w = weights[~mapped]
+            ov = self.config.overflow_row
+            if ov is not None:
+                self.overflowed_cells += len(um_rows)
+                ov_codec = self.row_codec[ov]
+                ov_storage = self._enc[ov_codec, um_idx]
+                ov_slots = self.page_table[ov, ov_storage // page]
+                out_rows = np.concatenate([slots[mapped], ov_slots])
+                out_offs = np.concatenate(
+                    [offs[mapped], (ov_storage % page).astype(np.int32)]
+                )
+                out_w = np.concatenate([weights[mapped], um_w])
+            else:
+                self.spilled_cells += len(um_rows)
+                spilled_total = int(um_w.sum())
+                with self._lock:
+                    for r, d, w in zip(um_rows, um_idx, um_w):
+                        key = (int(r), int(d))
+                        self._host_spill[key] = (
+                            self._host_spill.get(key, 0) + int(w)
+                        )
+                out_rows = slots[mapped]
+                out_offs = offs[mapped]
+                out_w = weights[mapped]
+
+        dev = np.empty((len(out_rows), 3), dtype=np.int32)
+        dev[:, 0] = out_rows
+        dev[:, 1] = out_offs
+        dev[:, 2] = out_w  # caller guarantees < 2^30 per cell
+        return dev, int(out_w.sum()), spilled_total
+
+    def commit(self, packed: np.ndarray) -> int:
+        """Translate + device-commit one packed triple batch.  Returns
+        the total count applied (device + host spill).  Launches pad to
+        COMMIT_CHUNK multiples so one executable serves every interval;
+        H2D accounting covers the padded wire bytes actually shipped."""
+        from loghisto_tpu.ops.paged_store import COMMIT_CHUNK
+
+        dev, applied, spilled = self.translate(
+            np.ascontiguousarray(packed, dtype=np.int32)
+        )
+        n = len(dev)
+        if n:
+            import jax.numpy as jnp
+
+            padded = -(-n // COMMIT_CHUNK) * COMMIT_CHUNK
+            if padded != n:
+                pad = np.zeros((padded - n, 3), dtype=np.int32)
+                pad[:, 0] = -1
+                dev = np.concatenate([dev, pad])
+            self._pool = self._commit(self._pool, jnp.asarray(dev))
+            self.commits += 1
+            self.last_h2d_bytes = dev.nbytes
+            self.h2d_bytes += dev.nbytes
+        else:
+            self.last_h2d_bytes = 0
+        return applied + spilled
+
+    def warmup(self) -> None:
+        """Pre-compile THE commit executable (one all-pad COMMIT_CHUNK
+        launch — numerically a no-op: slot -1 triples drop).  Every
+        later commit pads to COMMIT_CHUNK multiples, so this single
+        compile covers all of them; without it the first real interval
+        pays the cold XLA compile (the dense bridge's _bridge_warmup
+        rationale, applied to the paged wire)."""
+        from loghisto_tpu.ops.paged_store import COMMIT_CHUNK
+
+        import jax.numpy as jnp
+
+        pad = np.zeros((COMMIT_CHUNK, 3), dtype=np.int32)
+        pad[:, 0] = -1
+        self._pool = self._commit(self._pool, jnp.asarray(pad))
+
+    # -- failure / spill ------------------------------------------------- #
+
+    def pool_deleted(self) -> bool:
+        return getattr(self._pool, "is_deleted", lambda: False)()
+
+    def reset_pool(self) -> None:
+        """Fresh zero pool (device-failure recovery).  Page-table
+        mappings survive — the pages are zero again, counts already
+        accounted by the caller's shed path."""
+        import jax.numpy as jnp
+
+        self._pool = jnp.zeros(
+            (self.config.pool_pages, self.config.page_size), dtype=jnp.int32
+        )
+
+    def spill_pool(self) -> None:
+        """Fold every device count into the exact host spill and zero
+        the pool (the paged twin of the dense _spill_fold: called when
+        an interval's totals could overflow int32 cells)."""
+        rows_d, idx_d, counts = self._decode_pool_cells()
+        with self._lock:
+            for r, d, w in zip(rows_d, idx_d, counts):
+                key = (int(r), int(d))
+                self._host_spill[key] = self._host_spill.get(key, 0) + int(w)
+        self.reset_pool()
+
+    def spill_cells(
+        self, rows: np.ndarray, dense_idx: np.ndarray, weights: np.ndarray
+    ) -> None:
+        """Exact host-spill add for pre-bucketed cells (dense-axis
+        indices), any magnitude."""
+        with self._lock:
+            for r, d, w in zip(rows, dense_idx, weights):
+                key = (int(r), int(d))
+                self._host_spill[key] = self._host_spill.get(key, 0) + int(w)
+
+    # -- decode / stats -------------------------------------------------- #
+
+    def _decode_pool_cells(
+        self,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """All nonzero pool cells decoded to (row, native dense index,
+        count int64) — one D2H of the pool, O(occupied) host work.
+        Counts of distinct storage buckets never merge here (decode
+        LUTs are injective per codec), but two storage buckets of
+        DIFFERENT rows may share a pool page only if mapped there, so
+        ownership comes from the page table, not the pool."""
+        pool_np = np.asarray(self._pool)
+        # slot -> (row, page_idx) ownership from the table
+        mapped = self.page_table >= 0
+        rows_of, pages_of = np.nonzero(mapped)
+        slots_of = self.page_table[rows_of, pages_of]
+        out_rows, out_idx, out_counts = [], [], []
+        page = self.config.page_size
+        for r, p, s in zip(rows_of, pages_of, slots_of):
+            counts = pool_np[s]
+            nz = np.nonzero(counts)[0]
+            if not len(nz):
+                continue
+            codec = self._codecs[self.row_codec[r]]
+            storage = p * page + nz
+            # dense pages can overhang the storage axis; the translate
+            # step never writes there
+            in_range = storage < codec.storage_buckets
+            storage = storage[in_range]
+            nz = nz[in_range]
+            out_rows.append(np.full(len(nz), r, dtype=np.int64))
+            out_idx.append(codec.dec_lut[storage].astype(np.int64))
+            out_counts.append(counts[nz].astype(np.int64))
+        if not out_rows:
+            empty = np.empty(0, dtype=np.int64)
+            return empty, empty.copy(), empty.copy()
+        return (
+            np.concatenate(out_rows),
+            np.concatenate(out_idx),
+            np.concatenate(out_counts),
+        )
+
+    def decode_cells(
+        self, include_spill: bool = True
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(rows, native dense indices, int64 counts) across pool +
+        host spill — the canonical sparse view of the whole store."""
+        rows, idx, counts = self._decode_pool_cells()
+        if include_spill and self._host_spill:
+            with self._lock:
+                items = list(self._host_spill.items())
+            s_rows = np.array([k[0] for k, _ in items], dtype=np.int64)
+            s_idx = np.array([k[1] for k, _ in items], dtype=np.int64)
+            s_cnt = np.array([v for _, v in items], dtype=np.int64)
+            rows = np.concatenate([rows, s_rows])
+            idx = np.concatenate([idx, s_idx])
+            counts = np.concatenate([counts, s_cnt])
+        return rows, idx, counts
+
+    def decode_dense(self, include_spill: bool = True) -> np.ndarray:
+        """Canonical dense [M, B] int64 reconstruction (checkpoint
+        portability: a paged save restores into a dense target and vice
+        versa).  O(M x B) host memory — checkpoint-path only."""
+        acc = np.zeros((self.num_metrics, self.num_buckets), dtype=np.int64)
+        rows, idx, counts = self.decode_cells(include_spill)
+        np.add.at(acc, (rows, idx), counts)
+        return acc
+
+    def stats(self, ps: np.ndarray, reset: bool = True):
+        """Per-metric counts/sums/percentiles across every stored cell
+        (pool + spill), computed sparsely: O(occupied cells), never a
+        dense [M, B] materialization.  Bit-identical to the dense host
+        oracle (dense_stats_np) for identity-codec rows; compressed
+        rows stay inside their codec's max_rel_error bound."""
+        from loghisto_tpu.ops.stats import sparse_cells_stats
+
+        rows, idx, counts = self.decode_cells(include_spill=True)
+        out = sparse_cells_stats(
+            rows, idx, counts, self.num_metrics, np.asarray(ps),
+            self.bucket_limit, self.precision,
+        )
+        if reset:
+            self.reset_pool()
+            with self._lock:
+                self._host_spill.clear()
+        return out
+
+    def query(self, ids: np.ndarray, ps: np.ndarray):
+        """Device-served snapshot query over the paged pool: rows group
+        by codec (one executable per codec), each group gathers only
+        its mapped pages and runs the dense engine's
+        snapshot_row_stats.  Returns counts/sums/percentiles stacked in
+        the request's id order.  Host-spill counts are NOT visible here
+        (same contract as the dense snapshot engine, which serves the
+        device tensor; spilled intervals read via stats())."""
+        import jax.numpy as jnp
+
+        from loghisto_tpu.ops.paged_store import make_paged_query_fn
+
+        ids = np.asarray(ids, dtype=np.int64)
+        ps_f = np.asarray(ps, dtype=np.float32)
+        n, p_n = len(ids), len(ps_f)
+        counts = np.zeros(n, dtype=np.int64)
+        sums = np.zeros(n, dtype=np.float64)
+        pcts = np.zeros((n, p_n), dtype=np.float64)
+        qfn = make_paged_query_fn(self.bucket_limit, self.precision)
+        codecs = self.row_codec[ids]
+        for cid in np.unique(codecs):
+            sel = np.nonzero(codecs == cid)[0]
+            if cid < 0:
+                continue  # untouched rows: zeros
+            codec = self._codecs[cid]
+            table_rows = self.page_table[ids[sel]]
+            out = qfn(
+                self._pool,
+                jnp.asarray(table_rows),
+                jnp.asarray(codec.dec_lut),
+                jnp.asarray(ps_f),
+            )
+            counts[sel] = np.asarray(out["counts"])
+            sums[sel] = np.asarray(out["sums"])
+            pcts[sel] = np.asarray(out["percentiles"])
+        return {"counts": counts, "sums": sums, "percentiles": pcts}
+
+    # -- lifecycle composition ------------------------------------------- #
+
+    def fold_rows_into(self, victims: List[int], target: int) -> int:
+        """Count-exact eviction fold: decode each victim row's cells,
+        re-encode them under the TARGET row's codec pages (the
+        overflow row), release the victim's pages, and clear its codec.
+        Returns the total count moved."""
+        victims = [int(v) for v in victims if v != target]
+        if not victims:
+            return 0
+        rows, idx, counts = self.decode_cells(include_spill=False)
+        moved = 0
+        sel = np.isin(rows, victims)
+        if sel.any():
+            packed = np.empty((int(sel.sum()), 3), dtype=np.int32)
+            packed[:, 0] = target
+            packed[:, 1] = idx[sel] - self.bucket_limit
+            packed[:, 2] = counts[sel]
+            moved = int(counts[sel].sum())
+            # zero the victim pages BEFORE recommitting so the fold
+            # cannot double-count (commit touches only target pages)
+            self._zero_rows(victims)
+            self.commit(packed)
+        else:
+            self._zero_rows(victims)
+        # host-spill cells of victims move too
+        with self._lock:
+            spill_items = [
+                (k, v) for k, v in self._host_spill.items()
+                if k[0] in set(victims)
+            ]
+            for k, v in spill_items:
+                del self._host_spill[k]
+                tkey = (target, k[1])
+                self._host_spill[tkey] = self._host_spill.get(tkey, 0) + v
+                moved += v
+        self.release_rows(victims)
+        return moved
+
+    def _zero_rows(self, rows: List[int]) -> None:
+        import jax.numpy as jnp
+
+        slots = self.page_table[rows].reshape(-1)
+        slots = slots[slots >= 0]
+        if len(slots):
+            self._pool = self._pool.at[jnp.asarray(slots)].set(0)
+
+    def release_rows(self, rows: List[int]) -> int:
+        """Return every page mapped by ``rows`` to the free pool (pages
+        must already be folded/zeroed by the caller); unassign their
+        codecs.  Returns the number of pages freed."""
+        freed = 0
+        for r in rows:
+            for p in range(self.pages_per_row):
+                slot = int(self.page_table[r, p])
+                if slot > 0:
+                    self._free.append(slot)
+                    self.page_table[r, p] = -1
+                    freed += 1
+            self.row_codec[r] = -1
+        self.released_pages += freed
+        return freed
+
+    def apply_permutation(self, perm: List[int], m_rows: int) -> None:
+        """Survivor repack: row r of the new layout takes old row
+        perm[r] (-1 = hole -> unmapped).  Pure host table permutation —
+        pool pages never move, so compaction is O(M) with zero device
+        traffic (vs the dense path's full gather/scatter repack)."""
+        new_table = np.full_like(self.page_table, -1)
+        new_codec = np.full_like(self.row_codec, -1)
+        for new_id, old_id in enumerate(perm[:m_rows]):
+            if old_id is None or old_id < 0:
+                continue
+            new_table[new_id] = self.page_table[old_id]
+            new_codec[new_id] = self.row_codec[old_id]
+        self.page_table = new_table
+        self.row_codec = new_codec
+        with self._lock:
+            remap = {
+                old_id: new_id
+                for new_id, old_id in enumerate(perm[:m_rows])
+                if old_id is not None and old_id >= 0
+            }
+            spill = {}
+            for (r, d), v in self._host_spill.items():
+                nr = remap.get(r)
+                if nr is not None:
+                    spill[(nr, d)] = spill.get((nr, d), 0) + v
+            self._host_spill = spill
+
+    def grow(self, new_m: int) -> None:
+        if new_m <= self.num_metrics:
+            return
+        extra = new_m - self.num_metrics
+        self.page_table = np.concatenate(
+            [
+                self.page_table,
+                np.full((extra, self.pages_per_row), -1, dtype=np.int32),
+            ]
+        )
+        self.row_codec = np.concatenate(
+            [self.row_codec, np.full(extra, -1, dtype=np.int8)]
+        )
+        self.num_metrics = new_m
+
+    def max_cell(self) -> int:
+        """Largest single pool count (spill-threshold headroom checks)."""
+        import jax.numpy as jnp
+
+        return int(jnp.max(self._pool))
+
+    # -- checkpoint ------------------------------------------------------ #
+
+    def codec_names(self) -> List[Optional[str]]:
+        return [
+            self._codecs[c].name if c >= 0 else None for c in self.row_codec
+        ]
+
+    def restore_codecs(self, names: List[Optional[str]]) -> None:
+        for row, name in enumerate(names[: self.num_metrics]):
+            if name is not None and self.row_codec[row] < 0:
+                self.row_codec[row] = self._codec_ids[name]

@@ -469,6 +469,8 @@ class TPUAggregator:
         max_metrics: Optional[int] = None,
         spill_threshold: int = 1 << 30,
         transport: str = "auto",
+        storage: str = "auto",
+        paged_config=None,
     ):
         """When `mesh` is given (a ("stream","metric") mesh from
         parallel.mesh.make_mesh), the dense accumulator is laid out
@@ -539,7 +541,23 @@ class TPUAggregator:
             capture-overridable).  "preagg" is never auto-picked: its
             record-time fold taxes producer threads, which only wins
             when producers aren't the bottleneck — a property no
-            flush-side probe can observe."""
+            flush-side probe can observe.
+
+        `storage` picks the accumulator backend (r14):
+          * "dense" — the donated [M, B] int32 tensor (every row pays
+            full bucket capacity in HBM and commit bytes).
+          * "paged" — page pool + per-row page table + per-metric
+            variable-resolution codecs (loghisto_tpu/paging.py): HBM
+            and commit H2D track OCCUPIED buckets.  Requires the
+            sparse packed-triple transport (pinned automatically when
+            transport="auto"; explicit "raw"/"preagg" raises) and a
+            single device (no mesh).
+          * "auto"  — (default) resolve_storage_path: paged at high
+            metric cardinality (PAGED_MIN_METRICS rows) where the
+            dense tensor's HBM cost bites, dense below it — the
+            declining reason lands in `storage_reason`.
+        `paged_config` takes a paging.PagedStoreConfig (pool size,
+        codec policy, overflow row)."""
         self.config = config
         self.num_metrics = num_metrics
         # explicit None check: an empty registry is falsy (it has __len__),
@@ -705,6 +723,19 @@ class TPUAggregator:
         # / SPARSE_DENSITY_CROSSOVER).  "preagg" stays an explicit
         # opt-in: its record-time fold trades producer-thread CPU for
         # flush latency, a workload property no flush-side probe sees.
+        # storage backend (r14): resolved BEFORE the transport rewrite
+        # below because paged storage pins the sparse transport (the
+        # page-table translate step rides the packed-triple fold).
+        from loghisto_tpu.ops.dispatch import resolve_storage_path
+
+        self.storage, self.storage_reason = resolve_storage_path(
+            storage, num_metrics, config.num_buckets,
+            jax.default_backend(), mesh=mesh is not None,
+            transport=transport,
+        )
+        self.paged = None
+        if self.storage == "paged":
+            transport = "sparse"  # auto pins; raw/preagg raised above
         self._transport_auto = transport == "auto"
         self.probe_density: Optional[float] = None
         if transport == "auto":
@@ -747,6 +778,25 @@ class TPUAggregator:
             self._acc = make_sharded_accumulator(
                 mesh, num_metrics, config.num_buckets
             )
+        elif self.storage == "paged":
+            from loghisto_tpu.paging import PagedStore, PagedStoreConfig
+
+            if ingest_path == "multirow":
+                raise ValueError(
+                    "ingest_path='multirow' needs the dense lane-padded "
+                    "accumulator; paged storage keeps none (every paged "
+                    "commit rides the packed sparse-triple scatter)"
+                )
+            self.paged = PagedStore(
+                num_metrics,
+                config.bucket_limit,
+                config.precision,
+                config=paged_config or PagedStoreConfig(),
+            )
+            # no dense [M, B] tensor exists in paged mode — the pool +
+            # page table ARE the accumulator.  Every _acc touch below is
+            # behind a `self.paged is not None` branch.
+            self._acc = None
         else:
             self._acc = jnp.zeros(
                 (num_metrics, config.num_buckets), dtype=jnp.int32
@@ -947,6 +997,14 @@ class TPUAggregator:
         new_m -= new_m % unit  # clamp may land off-grid; round down
         if new_m <= old_m:
             return False
+        if self.paged is not None:
+            # paged growth is a host-side page-table extension: no device
+            # tensor is reallocated, no kernel is rebuilt, no data moves.
+            self.paged.grow(new_m)
+            self.num_metrics = new_m
+            self.stats_snapshot = None
+            self.registry.grow(new_m)
+            return True
         # -- fallible section: build everything in locals first --
         make_acc, ingest, finalize = (
             self._make_acc, self._ingest, self._finalize_acc
@@ -1005,6 +1063,14 @@ class TPUAggregator:
         Keeps
         every per-cell device count below spill_threshold + one flush
         round — the int32 overflow guarantee."""
+        if self.paged is not None:
+            # decode pool -> host spill dict inside the store (exact:
+            # spill cells keep native dense indices), zero the pool
+            self.paged.spill_pool()
+            self._spilled_samples += self._interval_ingested
+            self._interval_ingested = 0
+            self.stats_snapshot = None
+            return
         acc_np = np.asarray(self._finalize_acc(self._acc), dtype=np.int64)
         if self._spill is None:
             self._spill = acc_np
@@ -1294,11 +1360,16 @@ class TPUAggregator:
         return True
 
     def close(self) -> None:
-        """Drain everything and stop the transfer worker.  flush(force)
-        first fully drains the staging ring and queue (exact count
-        conservation — nothing in flight is dropped), then the worker is
-        signalled down and joined.  The aggregator stays usable: a later
-        flush lazily re-spawns the worker."""
+        """Drain everything and stop the transfer worker, in two phases.
+        flush(force) drains the host buffers and the transfer QUEUE —
+        but NOT the staging ring: with the r13 double-buffered pipeline,
+        up to ring-depth async uploads can still be in flight after the
+        queue empties (stage() only waits for the slot it is about to
+        reuse).  Phase two below drains those in-flight slots under
+        _dev_lock (ring.drain()), restoring exact count conservation —
+        nothing staged is dropped.  Then the worker is signalled down
+        and joined.  The aggregator stays usable: a later flush lazily
+        re-spawns the worker."""
         self.flush(force=True)
         # r13 double-buffering means up to ring-depth async uploads can
         # still be in flight after the queue drains (stage() only waits
@@ -1516,6 +1587,28 @@ class TPUAggregator:
         exact sample conservation: everything before the failing offset
         was applied, everything from it on is requeued from the host
         arrays (which also covers a staged-but-undispatched next slot)."""
+        if self.paged is not None:
+            # reached only through _process_fold's MemoryError fallback
+            # (paged pins transport="sparse").  There is no dense device
+            # loop to fall back to, and re-entering the fold would repeat
+            # the failed allocation — compress on the host and take the
+            # exact spill instead.  Rare by construction; correctness
+            # over throughput.
+            from loghisto_tpu._native import compress_np_host
+
+            buckets = compress_np_host(
+                values.astype(np.float64), self.config.precision
+            )
+            np.clip(
+                buckets, -self.config.bucket_limit,
+                self.config.bucket_limit, out=buckets,
+            )
+            with self._dev_lock:
+                self._spill_add_cells_locked(
+                    ids, buckets, np.ones(len(ids), dtype=np.int64)
+                )
+            self._xfer_samples_shipped += n
+            return
         bs = self.batch_size
         ring = self._staging_ring
         if ring is None or ring.slot_samples != 8 * bs:
@@ -1664,6 +1757,18 @@ class TPUAggregator:
             self._spill_fold_locked()
             self._spill_add_packed_locked(packed)
             return
+        if self.paged is not None:
+            # the store translates (row, codec bucket, count) against the
+            # page table and pads to COMMIT_CHUNK internally; cells that
+            # can't get a page go to the store's exact host spill
+            try:
+                self._interval_ingested += self.paged.commit(packed)
+            except Exception:
+                self._on_device_failure_locked()
+                self._spill_add_packed_locked(packed)
+                return
+            self._device_down_until = 0.0
+            return
         for off in range(0, n, _MERGE_CHUNK):
             take = min(_MERGE_CHUNK, n - off)
             pad = np.empty((_MERGE_CHUNK, 3), dtype=np.int32)
@@ -1706,6 +1811,16 @@ class TPUAggregator:
                 self._shed_samples += self._interval_ingested
             self._interval_ingested = 0
             self._acc = self._fresh_acc()
+        if self.paged is not None and self.paged.pool_deleted():
+            logging.getLogger("loghisto_tpu").error(
+                "device failure consumed the donated page pool; %d "
+                "already-ingested samples of this interval are lost",
+                self._interval_ingested,
+            )
+            with self._shed_lock:
+                self._shed_samples += self._interval_ingested
+            self._interval_ingested = 0
+            self.paged.reset_pool()
         self.stats_snapshot = None
         if self.device_breaker is not None:
             # the SINGLE breaker count point per physical failure: the
@@ -1754,6 +1869,24 @@ class TPUAggregator:
     ) -> None:
         """Add (id, codec bucket, weight) cells to the host int64 spill —
         exact at any magnitude.  Caller holds _dev_lock."""
+        if self.paged is not None:
+            # paged mode keeps its spill as a sparse host dict inside the
+            # store (a dense [M, B] int64 tensor at 1M rows would defeat
+            # the whole backend); same exactness contract
+            keep = (ids_np >= 0) & (ids_np < self.num_metrics)
+            dense_idx = (
+                np.clip(
+                    bidx_np[keep],
+                    -self.config.bucket_limit,
+                    self.config.bucket_limit,
+                )
+                + self.config.bucket_limit
+            )
+            self.paged.spill_cells(
+                ids_np[keep].astype(np.int64), dense_idx, weights_np[keep]
+            )
+            self._spilled_samples += int(weights_np[keep].sum())
+            return
         if self._spill is None:
             self._spill = np.zeros(
                 (self.num_metrics, self.config.num_buckets), dtype=np.int64
@@ -1793,6 +1926,18 @@ class TPUAggregator:
             # the host spill in exact int64
             self._spill_fold_locked()
             self._spill_add_cells_locked(ids_np, bidx_np, weights_np)
+            return
+        if self.paged is not None:
+            # repack to the triple wire and ride the paged commit path.
+            # int32 casts are safe here: the guard above bounds every
+            # weight below 1 << 30 and ids/buckets are clipped in commit.
+            packed = np.empty((n, 3), dtype=np.int32)
+            packed[:, 0] = ids_np
+            packed[:, 1] = np.clip(
+                bidx_np, -self.config.bucket_limit, self.config.bucket_limit
+            )
+            packed[:, 2] = weights_np
+            self._merge_packed_locked(packed)
             return
         # ONE fixed launch shape (not a power-of-two ladder): every merge
         # reuses the single executable _bridge_warmup pre-compiled, so no
@@ -1836,6 +1981,10 @@ class TPUAggregator:
         host reaper keeps ticking, fills the freshly subscribed channel,
         and strike-evicts it (metrics.go:565-581 semantics) before the
         bridge ever processes an interval."""
+        if self.paged is not None:
+            with self._dev_lock:
+                self.paged.warmup()
+            return
         ids = np.full(_MERGE_CHUNK, -1, dtype=np.int32)
         zeros = np.zeros(_MERGE_CHUNK, dtype=np.int32)
         with self._dev_lock:
@@ -1941,38 +2090,54 @@ class TPUAggregator:
         # (With reset=False the accumulator keeps flowing, so it must be
         # copied under the lock — a later flush() would otherwise donate
         # the very buffer stats are reading.)
-        with self._dev_lock:
-            acc = self._acc
-            spill = self._spill
-            if reset:
-                # zeros_like preserves the NamedSharding in mesh mode
-                self._acc = jnp.zeros_like(acc)
-                self._interval_ingested = 0
-                self._spill = None
-                self._spilled_samples = 0
-                self.stats_snapshot = None
-            else:
-                acc = acc + 0  # defensive copy; donation-safe snapshot
-                spill = None if spill is None else spill.copy()
-        from loghisto_tpu.utils.trace import maybe_capture
-
-        if spill is not None:
-            # overflow-spill interval: counts exceed int32 on device, so
-            # the whole extraction runs in exact int64 on host
-            combined = spill + np.asarray(
-                self._finalize_acc(acc), dtype=np.int64
-            )
-            stats = dense_stats_np(
-                combined,
-                np.asarray(ps, dtype=np.float64),
-                self.config.bucket_limit,
-                self.config.precision,
-            )
-        else:
-            with maybe_capture("loghisto_collect"):
-                stats = self._stats_fn(
-                    self._finalize_acc(acc), np.asarray(ps, dtype=np.float32)
+        if self.paged is not None:
+            # the paged stats program runs the per-codec gathered
+            # extraction inside the store (sparse_cells_stats —
+            # percentiles are bit-identical to the dense selection), with
+            # the store's exact host spill already folded in, so no dense
+            # combine step exists on this branch
+            with self._dev_lock:
+                stats = self.paged.stats(
+                    np.asarray(ps, dtype=np.float64), reset=reset
                 )
+                if reset:
+                    self._interval_ingested = 0
+                    self._spilled_samples = 0
+                    self.stats_snapshot = None
+        else:
+            with self._dev_lock:
+                acc = self._acc
+                spill = self._spill
+                if reset:
+                    # zeros_like preserves the NamedSharding in mesh mode
+                    self._acc = jnp.zeros_like(acc)
+                    self._interval_ingested = 0
+                    self._spill = None
+                    self._spilled_samples = 0
+                    self.stats_snapshot = None
+                else:
+                    acc = acc + 0  # defensive copy; donation-safe snapshot
+                    spill = None if spill is None else spill.copy()
+            from loghisto_tpu.utils.trace import maybe_capture
+
+            if spill is not None:
+                # overflow-spill interval: counts exceed int32 on device,
+                # so the whole extraction runs in exact int64 on host
+                combined = spill + np.asarray(
+                    self._finalize_acc(acc), dtype=np.int64
+                )
+                stats = dense_stats_np(
+                    combined,
+                    np.asarray(ps, dtype=np.float64),
+                    self.config.bucket_limit,
+                    self.config.precision,
+                )
+            else:
+                with maybe_capture("loghisto_collect"):
+                    stats = self._stats_fn(
+                        self._finalize_acc(acc),
+                        np.asarray(ps, dtype=np.float32),
+                    )
         counts = np.asarray(stats["counts"])
         sums = np.asarray(stats["sums"])
         pcts = np.asarray(stats["percentiles"])
@@ -2072,3 +2237,22 @@ class TPUAggregator:
         ms.register_gauge_func(
             "tpu.SpilledSamples", lambda: float(self._spilled_samples)
         )
+        if self.paged is not None:
+            ms.register_gauge_func(
+                "tpu.PagedOccupiedPages",
+                lambda: float(self.paged.occupied_pages),
+            )
+            ms.register_gauge_func(
+                "tpu.PagedFreePages", lambda: float(self.paged.free_pages)
+            )
+            ms.register_gauge_func(
+                "tpu.PagedHbmBytes", lambda: float(self.paged.hbm_bytes())
+            )
+            ms.register_gauge_func(
+                "tpu.PagedSpilledCells",
+                lambda: float(self.paged.spilled_cells),
+            )
+            ms.register_gauge_func(
+                "tpu.PagedLastCommitH2DBytes",
+                lambda: float(self.paged.last_h2d_bytes),
+            )

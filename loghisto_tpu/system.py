@@ -52,6 +52,8 @@ class TPUMetricSystem(MetricSystem):
         lifecycle=None,
         anomaly=None,
         transport: str = "auto",
+        storage: str = "auto",
+        paged_config=None,
         observability=None,
         resilience=None,
         federation=None,
@@ -99,6 +101,21 @@ class TPUMetricSystem(MetricSystem):
         ``transport`` passes through to the TPUAggregator's host->device
         transport selection ("auto" / "raw" / "preagg" / "sparse"; see
         TPUAggregator.__init__).
+
+        ``storage`` picks the accumulator backend ("auto" / "dense" /
+        "paged"; PR 14): "paged" replaces the dense ``[M, B]`` device
+        tensor with an occupancy-tracked page pool + host page table
+        and per-metric variable-resolution codecs — HBM and commit H2D
+        cost scale with occupied buckets, not capacity, which is what
+        makes 1M live metric rows fit one chip.  "auto" follows
+        ``ops.dispatch.resolve_storage_path`` (dense below the
+        PAGED_MIN_METRICS crossover, or whenever a mesh / non-sparse
+        transport rules paging out; ``aggregator.storage_reason`` says
+        why).  ``paged_config`` takes a ``paging.PagedStoreConfig``
+        (pool size, codec policy, overflow row).  Paged storage keeps
+        no dense carry, so it composes with the fan-out commit, not the
+        fused committer — ``commit="auto"`` degrades, explicit
+        ``commit="fused"`` raises with the reason.
 
         ``observability`` takes an ``obs.ObsConfig`` (or ``True`` for
         the defaults) and turns on the self-observability subsystem
@@ -181,6 +198,8 @@ class TPUMetricSystem(MetricSystem):
             mesh=mesh,
             native_staging=native_staging,
             transport=transport,
+            storage=storage,
+            paged_config=paged_config,
         )
         self.aggregator.register_device_gauges(self)
         if self.resilience is not None:

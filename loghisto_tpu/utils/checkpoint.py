@@ -28,7 +28,13 @@ from loghisto_tpu.metrics import MetricSystem
 # v2: optional interval-seq watermark rides the payload so crash
 # recovery can replay ONLY journal intervals past the snapshotted state
 # (resilience/recovery.py).  v1 files load fine — watermark None.
-FORMAT_VERSION = 2
+# v3: paged aggregators (PR 14) snapshot portably — `agg_acc` carries
+# the canonical dense decode of the page pool + host spill (so any
+# storage mode restores any save), and `pg_codec_names` records each
+# row's codec choice so a paged restore re-pins resolutions instead of
+# re-deriving them from the first post-restore interval.  v1/v2 files
+# load fine — codecs None.
+FORMAT_VERSION = 3
 
 
 def save(
@@ -96,14 +102,28 @@ def save(
         # staged samples from the snapshot
         aggregator.flush(force=True)
         with aggregator._dev_lock:
-            # canonical dense layout: snapshots stay portable across
-            # ingest_path choices (multirow's lane padding is stripped)
-            acc = np.asarray(aggregator._finalize_acc(aggregator._acc))
-            # a spilled interval keeps part of its counts in the host
-            # int64 fold — snapshotting only the device tensor would
-            # silently lose them; the combined snapshot is int64
-            if aggregator._spill is not None:
-                acc = acc.astype(np.int64) + aggregator._spill
+            if getattr(aggregator, "paged", None) is not None:
+                # canonical dense decode of pool + host spill: the
+                # snapshot is storage-portable (a dense aggregator
+                # restores a paged save and vice versa); codec choices
+                # ride alongside so a paged restore re-pins resolutions
+                acc = aggregator.paged.decode_dense(include_spill=True)
+                payload["pg_codec_names"] = _names_arr(
+                    aggregator.paged.codec_names()
+                )
+            else:
+                # canonical dense layout: snapshots stay portable across
+                # ingest_path choices (multirow's lane padding is
+                # stripped)
+                acc = np.asarray(
+                    aggregator._finalize_acc(aggregator._acc)
+                )
+                # a spilled interval keeps part of its counts in the
+                # host int64 fold — snapshotting only the device tensor
+                # would silently lose them; the combined snapshot is
+                # int64
+                if aggregator._spill is not None:
+                    acc = acc.astype(np.int64) + aggregator._spill
         with aggregator._agg_lock:
             agg_items = sorted(aggregator._agg.items())
         payload["agg_acc"] = acc
@@ -276,56 +296,52 @@ def restore(
             )
             for saved_id, new_id in row_map:
                 remapped[new_id] += acc[saved_id]
-            with aggregator._dev_lock:
-                # int64 snapshots (taken mid-spill) or counts too large
-                # for the int32 device tensor merge into the host spill
-                # instead — collect() folds spill + device exactly.  The
-                # live accumulator's hottest cell joins the headroom
-                # check: restored counts never increment
-                # _interval_ingested, so successive restores (merging
-                # several worker checkpoints) would otherwise stack
-                # toward 2^31 unseen by the spill trigger.
-                live_max = int(
-                    jnp.max(aggregator._finalize_acc(aggregator._acc))
-                )
-                if (
-                    int(remapped.max(initial=0))
-                    + live_max
-                    + aggregator.spill_threshold
-                    + aggregator.batch_size
-                ) >= 2**31:
-                    if aggregator._spill is None:
-                        aggregator._spill = remapped.astype(np.int64)
-                    else:
-                        aggregator._spill += remapped.astype(np.int64)
-                else:
-                    live_cols = aggregator._acc.shape[1]
-                    dense = remapped.astype(np.int32)
-                    if live_cols != dense.shape[1]:
-                        # re-pad the canonical dense rows into the live
-                        # (lane-padded) layout
-                        padded = np.zeros(
-                            (aggregator.num_metrics, live_cols),
-                            dtype=np.int32,
-                        )
-                        padded[:, :dense.shape[1]] = dense
-                        dense = padded
-                    # re-shard the host rows onto the live accumulator's
-                    # layout first: checkpoints save gathered host
-                    # arrays, so a snapshot taken on one mesh shape
-                    # restores onto any other (or none at all)
-                    delta = jnp.asarray(dense)
-                    live_sharding = getattr(
-                        aggregator._acc, "sharding", None
-                    )
+            if getattr(aggregator, "paged", None) is not None:
+                pg = aggregator.paged
+                with aggregator._dev_lock:
+                    # re-pin the saved codec choices first (by the same
+                    # by-name row map), so the recommit below encodes
+                    # each row at its saved resolution instead of
+                    # re-deriving from this one delta's occupancy
+                    if "pg_codec_names" in data:
+                        saved_codecs = _arr_names(data["pg_codec_names"])
+                        for saved_id, new_id in row_map:
+                            if (
+                                saved_id < len(saved_codecs)
+                                and saved_codecs[saved_id] is not None
+                            ):
+                                pg.set_row_codec(
+                                    new_id, saved_codecs[saved_id]
+                                )
+                    rows, cols = np.nonzero(remapped)
+                    weights = remapped[rows, cols].astype(np.int64)
+                    # same headroom rule as the dense branch: restored
+                    # counts never increment _interval_ingested, so big
+                    # deltas take the store's exact host spill
+                    live_max = pg.max_cell()
                     if (
-                        getattr(aggregator, "mesh", None) is not None
-                        and live_sharding is not None
-                    ):
-                        import jax
-
-                        delta = jax.device_put(delta, live_sharding)
-                    aggregator._acc = aggregator._acc + delta
+                        int(weights.max(initial=0))
+                        + live_max
+                        + aggregator.spill_threshold
+                        + aggregator.batch_size
+                    ) >= 2**31:
+                        pg.spill_cells(
+                            rows.astype(np.int64),
+                            cols.astype(np.int64),
+                            weights,
+                        )
+                    else:
+                        packed = np.empty((len(rows), 3), dtype=np.int32)
+                        packed[:, 0] = rows
+                        packed[:, 1] = (
+                            cols.astype(np.int64)
+                            - aggregator.config.bucket_limit
+                        )
+                        packed[:, 2] = weights
+                        pg.commit(packed)
+            else:
+                with aggregator._dev_lock:
+                    _restore_dense_delta(aggregator, remapped)
             id_remap = dict(row_map)
             with aggregator._agg_lock:
                 agg_compat = aggregator.config.go_compat
@@ -382,6 +398,56 @@ def restore(
                     "scored_intervals": int(counters[0]),
                 })
     return seq_watermark
+
+
+def _restore_dense_delta(aggregator, remapped: np.ndarray) -> None:
+    """Merge a remapped canonical-dense delta into a dense aggregator
+    (caller holds _dev_lock).  int64 snapshots (taken mid-spill) or
+    counts too large for the int32 device tensor merge into the host
+    spill instead — collect() folds spill + device exactly.  The live
+    accumulator's hottest cell joins the headroom check: restored
+    counts never increment _interval_ingested, so successive restores
+    (merging several worker checkpoints) would otherwise stack toward
+    2^31 unseen by the spill trigger."""
+    import jax.numpy as jnp
+
+    live_max = int(
+        jnp.max(aggregator._finalize_acc(aggregator._acc))
+    )
+    if (
+        int(remapped.max(initial=0))
+        + live_max
+        + aggregator.spill_threshold
+        + aggregator.batch_size
+    ) >= 2**31:
+        if aggregator._spill is None:
+            aggregator._spill = remapped.astype(np.int64)
+        else:
+            aggregator._spill += remapped.astype(np.int64)
+    else:
+        live_cols = aggregator._acc.shape[1]
+        dense = remapped.astype(np.int32)
+        if live_cols != dense.shape[1]:
+            # re-pad the canonical dense rows into the live
+            # (lane-padded) layout
+            padded = np.zeros(
+                (aggregator.num_metrics, live_cols), dtype=np.int32
+            )
+            padded[:, :dense.shape[1]] = dense
+            dense = padded
+        # re-shard the host rows onto the live accumulator's layout
+        # first: checkpoints save gathered host arrays, so a snapshot
+        # taken on one mesh shape restores onto any other (or none)
+        delta = jnp.asarray(dense)
+        live_sharding = getattr(aggregator._acc, "sharding", None)
+        if (
+            getattr(aggregator, "mesh", None) is not None
+            and live_sharding is not None
+        ):
+            import jax
+
+            delta = jax.device_put(delta, live_sharding)
+        aggregator._acc = aggregator._acc + delta
 
 
 def _names_arr(names) -> np.ndarray:

@@ -455,3 +455,123 @@ def test_injected_crash_mid_write_leaves_previous_snapshot(tmp_path):
         assert fresh.collect().metrics["m_count"] == 1
         leftovers = [p for p in tmp_path.iterdir() if p.suffix == ".tmp"]
         assert not leftovers
+
+
+# -- FORMAT_VERSION 3: paged storage portability (ISSUE 14) --------------- #
+
+
+def _paged_agg(codec="auto", **kw):
+    from loghisto_tpu.paging import PagedStoreConfig
+
+    kw.setdefault(
+        "paged_config", PagedStoreConfig(pool_pages=256, codec=codec)
+    )
+    return TPUAggregator(num_metrics=8, config=CFG, storage="paged", **kw)
+
+
+@pytest.mark.paged
+def test_v3_paged_save_restores_into_dense(tmp_path):
+    # a paged save carries the canonical dense decode, so a DENSE
+    # aggregator restores it with no knowledge of pages or codecs
+    src = _paged_agg()
+    src.record("m", 5.0)
+    src.record("m", 7.0)
+    src.flush(force=True)
+    path = str(tmp_path / "p2d.npz")
+    checkpoint.save(path, aggregator=src)
+    with np.load(path) as data:
+        assert int(data["version"]) == 3
+        assert "pg_codec_names" in data  # the codec sidecar rode along
+
+    dst = TPUAggregator(num_metrics=8, config=CFG)  # dense target
+    checkpoint.restore(path, aggregator=dst)
+    out = dst.collect().metrics
+    assert out["m_count"] == 2
+    assert abs(out["m_avg"] / 6.0 - 1) < 0.02
+
+
+@pytest.mark.paged
+def test_v3_dense_save_restores_into_paged(tmp_path):
+    src = TPUAggregator(num_metrics=8, config=CFG)
+    src.record("m", 5.0)
+    src.flush(force=True)
+    path = str(tmp_path / "d2p.npz")
+    checkpoint.save(path, aggregator=src)
+
+    dst = _paged_agg()
+    checkpoint.restore(path, aggregator=dst)
+    out = dst.collect().metrics
+    assert out["m_count"] == 1
+    assert abs(out["m_avg"] / 5.0 - 1) < 0.02
+
+
+@pytest.mark.paged
+def test_v3_paged_roundtrip_preserves_codec_choices(tmp_path):
+    # the source pinned a compressed codec; the restore must re-pin it
+    # BEFORE recommitting, not re-derive resolution from the delta
+    src = _paged_agg(codec="loglinear")
+    src.record("m", 5.0)
+    src.record("m", 7.0)
+    src.flush(force=True)
+    mid = src.registry.lookup("m")
+    assert src.paged.codec_names()[mid] == "loglinear"
+    path = str(tmp_path / "p2p.npz")
+    checkpoint.save(path, aggregator=src)
+
+    dst = _paged_agg()  # auto would have picked dense for this row
+    checkpoint.restore(path, aggregator=dst)
+    new_id = dst.registry.lookup("m")
+    assert dst.paged.codec_names()[new_id] == "loglinear"
+    out = dst.collect().metrics
+    assert out["m_count"] == 2
+
+
+@pytest.mark.paged
+def test_v2_file_restores_into_paged_without_codec_sidecar(tmp_path):
+    # the FORMAT_VERSION bump path: a pre-bump (v2) snapshot has no
+    # pg_codec_names — the paged restore assigns codecs from the delta
+    # occupancy instead of failing on the missing key
+    src = TPUAggregator(num_metrics=8, config=CFG)
+    src.record("m", 5.0)
+    src.flush(force=True)
+    path = str(tmp_path / "v2p.npz")
+    checkpoint.save(path, aggregator=src)
+    data = dict(np.load(path, allow_pickle=True))
+    data["version"] = np.int64(2)
+    data.pop("pg_codec_names", None)
+    np.savez(path, **data)
+
+    dst = _paged_agg()
+    checkpoint.restore(path, aggregator=dst)
+    out = dst.collect().metrics
+    assert out["m_count"] == 1
+
+
+@pytest.mark.paged
+def test_paged_successive_restores_route_to_store_spill(tmp_path):
+    # the paged twin of test_successive_restores_route_to_spill:
+    # restored counts never increment the interval counter, so the
+    # second worker merge must take the store's exact host spill
+    # instead of wrapping an int32 pool cell
+    import datetime
+
+    from loghisto_tpu.metrics import RawMetricSet
+
+    src = TPUAggregator(num_metrics=8, config=CFG, batch_size=64)
+    src.registry.id_for("hot")
+    per_worker = 900_000_000
+    raw = RawMetricSet(
+        time=datetime.datetime.now(tz=datetime.timezone.utc),
+        counters={}, rates={}, histograms={"hot": {10: per_worker}},
+        gauges={},
+    )
+    src.merge_raw(raw)
+    path = str(tmp_path / "pw.npz")
+    checkpoint.save(path, aggregator=src)
+
+    target = _paged_agg(batch_size=64)
+    checkpoint.restore(path, aggregator=target)
+    checkpoint.restore(path, aggregator=target)  # second worker merge
+    assert len(target.paged._host_spill) > 0  # headroom check fired
+    out = target.collect().metrics
+    assert out["hot_count"] == float(2 * per_worker)  # no int32 wrap

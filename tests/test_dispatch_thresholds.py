@@ -20,6 +20,8 @@ def restore_dispatch_globals():
         dispatch.SPARSE_KERNEL,
         dispatch.FUSED_INGEST,
         dispatch.FUSED_MIN_BATCH,
+        dispatch.PAGED_STORAGE,
+        dispatch.PAGED_MIN_METRICS,
         dispatch.THRESHOLDS_FILE,
         dispatch.THRESHOLDS_SOURCE,
     )
@@ -32,6 +34,8 @@ def restore_dispatch_globals():
         dispatch.SPARSE_KERNEL,
         dispatch.FUSED_INGEST,
         dispatch.FUSED_MIN_BATCH,
+        dispatch.PAGED_STORAGE,
+        dispatch.PAGED_MIN_METRICS,
         dispatch.THRESHOLDS_FILE,
         dispatch.THRESHOLDS_SOURCE,
     ) = saved
@@ -281,3 +285,116 @@ def test_resolve_commit_path_explicit_fused_raises_the_reason():
     with pytest.raises(ValueError, match="num_metrics=16"):
         dispatch.resolve_commit_path(
             "fused", "cpu", mesh=bad_rows, num_metrics=16)
+
+
+# -- paged storage resolution (r14) ------------------------------------- #
+
+def test_paged_storage_incapability_reason_strings():
+    # mesh wins over every other reason: the pool is a single-device arena
+    reason = dispatch.paged_storage_incapability(1 << 20, mesh=True)
+    assert reason is not None and "mesh" in reason
+    # non-sparse transports ship whole batches, no host fold to translate
+    reason = dispatch.paged_storage_incapability(1 << 20, transport="raw")
+    assert reason is not None and "transport" in reason
+    reason = dispatch.paged_storage_incapability(1 << 20, transport="preagg")
+    assert reason is not None and "transport" in reason
+    # a bucket axis narrower than one page can't amortize paging
+    reason = dispatch.paged_storage_incapability(
+        1 << 20, num_buckets=dispatch.PAGE_SIZE - 1
+    )
+    assert reason is not None and "bucket axis" in reason
+    # below the crossover the dense accumulator wins; the reason names
+    # the benchmark that set the bound
+    reason = dispatch.paged_storage_incapability(
+        dispatch.PAGED_MIN_METRICS - 1
+    )
+    assert reason is not None and "below crossover" in reason
+    assert "PAGED_STORE_r14" in reason
+    # a capable shape has no reason
+    assert dispatch.paged_storage_incapability(
+        dispatch.PAGED_MIN_METRICS
+    ) is None
+    # explicit selection skips the crossover check only
+    assert dispatch.paged_storage_incapability(
+        8, crossover=False
+    ) is None
+
+
+def test_resolve_storage_path_auto_degrades_with_reason():
+    storage, reason = dispatch.resolve_storage_path(
+        "auto", 8, 8193, "cpu"
+    )
+    assert storage == "dense"
+    assert reason is not None and "below crossover" in reason
+    storage, reason = dispatch.resolve_storage_path(
+        "auto", 1 << 20, 8193, "cpu", mesh=True
+    )
+    assert storage == "dense" and "mesh" in reason
+    storage, reason = dispatch.resolve_storage_path(
+        "auto", 1 << 20, 8193, "cpu"
+    )
+    assert storage == "paged" and reason is None
+    # dense stays an explicit opt-out, never second-guessed
+    storage, reason = dispatch.resolve_storage_path(
+        "dense", 1 << 20, 8193, "cpu"
+    )
+    assert storage == "dense" and reason is None
+
+
+def test_resolve_storage_path_explicit_paged_raises_the_reason():
+    # explicit paged skips the crossover (operator's call, like fused)...
+    storage, reason = dispatch.resolve_storage_path("paged", 8, 8193, "cpu")
+    assert storage == "paged" and reason is None
+    # ...but correctness blockers raise with the same reason string auto
+    # degrades on
+    with pytest.raises(ValueError, match="mesh"):
+        dispatch.resolve_storage_path("paged", 1 << 20, 8193, "cpu",
+                                      mesh=True)
+    with pytest.raises(ValueError, match="transport"):
+        dispatch.resolve_storage_path("paged", 1 << 20, 8193, "cpu",
+                                      transport="raw")
+    with pytest.raises(ValueError, match="bucket axis"):
+        dispatch.resolve_storage_path("paged", 1 << 20, 100, "cpu")
+    with pytest.raises(ValueError, match="unknown storage"):
+        dispatch.resolve_storage_path("quantum", 1 << 20, 8193, "cpu")
+
+
+def test_paged_threshold_overrides(tmp_path, restore_dispatch_globals):
+    """The r14 storage entries ride the same committed-JSON machinery:
+    paged_storage pins the backend off, paged_min_metrics retunes the
+    crossover."""
+    path = tmp_path / "dispatch_thresholds.json"
+    path.write_text(json.dumps({
+        "source": "TPU_CAPTURE_test",
+        "paged_storage": False,
+        "paged_min_metrics": 1 << 10,
+    }))
+    dispatch.THRESHOLDS_FILE = str(path)
+    dispatch._load_thresholds()
+    assert dispatch.PAGED_STORAGE is False
+    assert dispatch.PAGED_MIN_METRICS == 1 << 10
+    # the kill switch is a policy default, not a capability blocker
+    # (same semantic as FUSED_INGEST): auto degrades with a reason,
+    # explicit selection still resolves
+    storage, reason = dispatch.resolve_storage_path(
+        "auto", 1 << 20, 8193, "cpu"
+    )
+    assert storage == "dense" and "threshold table" in reason
+    assert dispatch.resolve_storage_path(
+        "paged", 1 << 20, 8193, "cpu"
+    ) == ("paged", None)
+    # retuned crossover applies
+    path.write_text(json.dumps({
+        "paged_storage": True, "paged_min_metrics": 1 << 10,
+    }))
+    dispatch._load_thresholds()
+    assert dispatch.resolve_storage_path(
+        "auto", 1 << 12, 8193, "cpu"
+    )[0] == "paged"
+    # wrong types must not poison the policy (bool is not an int count)
+    path.write_text(json.dumps({
+        "paged_storage": "sideways", "paged_min_metrics": True,
+    }))
+    dispatch._load_thresholds()
+    assert dispatch.PAGED_STORAGE is True
+    assert dispatch.PAGED_MIN_METRICS == 1 << 10

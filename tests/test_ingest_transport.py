@@ -168,6 +168,11 @@ def test_close_mid_flush_conserves_counts():
 
     _time.sleep(0.3)  # let flushes overlap the close
     agg.close()  # mid-flight: must drain, not drop
+    # close()'s phase two (ring.drain() under _dev_lock) must leave no
+    # in-flight double-buffered upload behind — the two-slot invariant
+    # the close() docstring promises
+    if agg._staging_ring is not None:
+        assert all(s is None for s in agg._staging_ring._inflight)
     stop.set()
     t.join()
     # writers kept recording after close's drain; final flush picks those

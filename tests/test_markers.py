@@ -57,5 +57,6 @@ def test_subsystem_markers_are_in_use():
     used = set(_used_markers())
     for marker in ("window", "commit", "query", "lifecycle",
                    "ingest_transport", "anomaly", "mesh_commit", "obs",
-                   "chaos", "federation", "fleet_obs", "ingest_fused"):
+                   "chaos", "federation", "fleet_obs", "ingest_fused",
+                   "paged"):
         assert marker in used, f"declared marker {marker!r} now unused"
