@@ -679,6 +679,25 @@ def main() -> None:
         print(f"bench: paged-storage stage failed: {e}", file=sys.stderr)
     ready12.set()
 
+    # label-serving headline (benchmarks/query_serving.py has the full
+    # closed-loop table): sustained selector QPS and serve p99 under
+    # live commits + label churn at the 10k-row shape, 8 query threads,
+    # with the zero-stale-serve check folded into meets_slo.  Duration
+    # shrinks off-TPU; a --tpu capture reruns the full grid.
+    ready13 = _start_watchdog(300.0, on_timeout=lambda: print(
+        json.dumps(result), flush=True
+    ))
+    try:
+        from benchmarks.query_serving import run as serving_run
+
+        qs = serving_run(duration=2.0 if platform == "tpu" else 1.0)
+        result["query_serving_qps"] = qs["query_serving_qps"]
+        result["query_serve_p99_us"] = qs["query_serve_p99_us"]
+        result["query_serving_meets_slo"] = qs["meets_slo"]
+    except Exception as e:  # never let the extra metric kill the bench
+        print(f"bench: query-serving stage failed: {e}", file=sys.stderr)
+    ready13.set()
+
     print(json.dumps(result))
 
 

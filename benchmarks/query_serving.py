@@ -1,0 +1,294 @@
+"""Sustained selector-serving under live commits + label churn (the
+PR-16 tentpole's receipts): a closed-loop multi-threaded harness where
+N query threads hammer brace selectors against a TimeWheel while a
+committer thread keeps committing fresh intervals AND churning the
+label population (lifecycle evictions + new label sets, so the
+registry generation keeps bumping and the inverted index keeps
+re-validating).
+
+Every served result is checked against the selector's own predicate:
+a row whose name does not satisfy the selector would mean a stale-id
+serve (an index entry surviving a generation bump, or a freed slot's
+new name leaking an old row) — the harness counts those and the run
+only "meets_slo" at >= 1k aggregate QPS with ZERO stale serves.
+
+The serving path under test is the snapshot query engine: warm repeats
+inside one interval are host result-cache hits, the first query after
+each commit pays one sparse gather dispatch, and every churn commit
+additionally pays the index rebuild (generation bump -> full re-index,
+the worst case for the label layer).  A separate one-shot leg times
+``query_group_by`` (gather + segment-sum + rank search) at each shape.
+
+Usage: python benchmarks/query_serving.py [--duration 2.0]
+       [--threads 8] [--full] [--out QUERY_SERVING_r16.json]
+Prints one JSON object; importable as ``run(...)`` for bench.py's
+headline (query_serving_qps / query_serve_p99_us).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime as _dt
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import numpy as np
+
+# (label, rows, bucket_limit, tiers, churn_every) — the 100k point
+# shrinks buckets/tier depth so the rings fit everywhere and churns
+# less often (every rebuild is O(rows)); it only runs with --full/TPU.
+CONFIGS = [
+    ("1000", 1_000, 128, ((8, 1), (4, 8)), 1),
+    ("10000", 10_000, 64, ((6, 1), (3, 8)), 2),
+    ("100000", 100_000, 32, ((4, 1),), 4),
+]
+
+ROUTES = 8
+CODES = ("200", "204", "500", "503")
+QPS_TARGET = 1_000.0
+
+
+def _base(i: int) -> str:
+    return f"svc{i}.latency"
+
+
+def _canon(base: str, route: int, code: str) -> str:
+    return f"{base};code={code};route=/r{route}"
+
+
+def _build(rows: int, bucket_limit: int, tiers):
+    from loghisto_tpu.commit import IntervalCommitter
+    from loghisto_tpu.config import MetricConfig
+    from loghisto_tpu.labels import LabelIndex
+    from loghisto_tpu.lifecycle import LifecycleConfig, LifecycleManager
+    from loghisto_tpu.parallel.aggregator import TPUAggregator
+    from loghisto_tpu.window import TimeWheel
+
+    per_base = ROUTES * len(CODES)
+    nbases = max(1, rows // per_base)
+    cfg = MetricConfig(bucket_limit=bucket_limit)
+    agg = TPUAggregator(num_metrics=rows, config=cfg)
+    wheel = TimeWheel(num_metrics=rows, config=cfg, interval=1.0,
+                      tiers=tiers, registry=agg.registry)
+    wheel.label_index = LabelIndex(agg.registry)
+    lc = LifecycleManager(
+        agg, wheel,
+        LifecycleConfig(check_every=1 << 30,
+                        auto_compact_fragmentation=0.0),
+    )
+    committer = IntervalCommitter(agg, wheel, lifecycle=lc)
+    committer.warmup()
+    names = []
+    for b in range(nbases):
+        for r in range(ROUTES):
+            for c in CODES:
+                if len(names) >= rows:
+                    break
+                names.append(_canon(_base(b), r, c))
+    for n in names:
+        agg.registry.id_for(n)
+    return committer, agg, wheel, lc, names, nbases
+
+
+def _interval(rng, i, names, bucket_limit, touch_frac=0.05):
+    from loghisto_tpu.metrics import RawMetricSet
+
+    t0 = _dt.datetime(2026, 1, 1, tzinfo=_dt.timezone.utc)
+    k = max(1, int(len(names) * touch_frac))
+    picks = rng.choice(len(names), size=k, replace=False)
+    hists = {}
+    for j in picks:
+        b = rng.integers(-bucket_limit, bucket_limit, 4)
+        c = rng.integers(1, 30, 4)
+        h = {}
+        for bb, cc in zip(b, c):
+            h[int(bb)] = h.get(int(bb), 0) + int(cc)
+        hists[names[j]] = h
+    return RawMetricSet(time=t0 + _dt.timedelta(seconds=i), counters={},
+                        rates={}, histograms=hists, gauges={},
+                        duration=1.0)
+
+
+def _selectors(nbases: int, thread_id: int):
+    """Per-thread selector mix: single-row exacts, per-route fans, and
+    one regex tail scan — rotated round-robin, 70/20/10 by weight."""
+    from loghisto_tpu.labels import parse_selector
+
+    rng = np.random.default_rng(1000 + thread_id)
+    sels = []
+    for _ in range(32):
+        b = _base(int(rng.integers(nbases)))
+        r = int(rng.integers(ROUTES))
+        c = CODES[int(rng.integers(len(CODES)))]
+        sels.extend([
+            f"{b}{{route=/r{r},code={c}}}",
+            f"{b}{{route=/r{r},code={c}}}",  # weight exacts heaviest
+            f"{b}{{route=/r{r}}}",
+        ])
+        if len(sels) % 9 == 0:
+            sels.append(f"{b}{{code=~5..}}")
+    return [(s, parse_selector(s).match_name) for s in sels]
+
+
+def _serve_loop(wheel, sels, window, stop, out):
+    lat, served, stale = [], 0, 0
+    i = 0
+    while not stop.is_set():
+        sel, pred = sels[i % len(sels)]
+        i += 1
+        t1 = time.perf_counter()
+        ws = wheel.query(sel, window=window)
+        lat.append(time.perf_counter() - t1)
+        served += 1
+        for name in ws.metrics:
+            if not pred(name):
+                stale += 1
+    out.append((lat, served, stale))
+
+
+def _churn(agg, lc, names, next_id: int) -> int:
+    """Evict the label set at the rotation head and register a fresh
+    one in its place: generation bump + freed-slot reuse, the two index
+    invalidation paths, exercised on every churn tick."""
+    victim = names[next_id % len(names)]
+    mid = agg.registry.lookup(victim)
+    if mid is not None:
+        lc.evict_ids([mid])
+    fresh = f"{victim.rsplit('=', 1)[0]}=/g{next_id}"
+    agg.registry.id_for(fresh)
+    names[next_id % len(names)] = fresh
+    return next_id + 1
+
+
+def run(duration: float = 2.0, threads: int = 8,
+        full: bool = False) -> dict:
+    import jax
+
+    platform = jax.devices()[0].platform
+    configs = CONFIGS if (full or platform == "tpu") else CONFIGS[:2]
+    result = {
+        "metric": "sustained selector QPS under live commits + churn",
+        "platform": platform,
+        "threads": threads,
+        "duration_s": duration,
+        "qps_target": QPS_TARGET,
+        "configs": {},
+    }
+    for label, rows, bucket_limit, tiers, churn_every in configs:
+        committer, agg, wheel, lc, names, nbases = _build(
+            rows, bucket_limit, tiers
+        )
+        rng = np.random.default_rng(0)
+        window = float(tiers[0][0] * tiers[0][1]) / 2.0
+        wheel.pin_window(window)
+        for i in range(3):  # warm: snapshots, jit, plan/glob caches
+            committer.commit(_interval(rng, i, names, bucket_limit))
+        sels = [_selectors(nbases, t) for t in range(threads)]
+        for s, _pred in sels[0][:4]:
+            wheel.query(s, window=window)
+
+        stop = threading.Event()
+        outs: list = []
+        workers = [
+            threading.Thread(target=_serve_loop,
+                             args=(wheel, sels[t], window, stop, outs),
+                             daemon=True)
+            for t in range(threads)
+        ]
+        t0 = time.perf_counter()
+        for w in workers:
+            w.start()
+        interval_i, churn_head, commits = 3, 0, 0
+        while time.perf_counter() - t0 < duration:
+            committer.commit(
+                _interval(rng, interval_i, names, bucket_limit)
+            )
+            interval_i += 1
+            commits += 1
+            if commits % churn_every == 0:
+                churn_head = _churn(agg, lc, names, churn_head)
+            time.sleep(0.005)
+        stop.set()
+        for w in workers:
+            w.join(timeout=10.0)
+        elapsed = time.perf_counter() - t0
+
+        lat = np.concatenate([np.asarray(o[0]) for o in outs if o[0]])
+        served = sum(o[1] for o in outs)
+        stale = sum(o[2] for o in outs)
+        qps = served / elapsed
+
+        # one-shot group_by leg at the same shape (own clock: rollups
+        # are a different dispatch, not part of the selector headline);
+        # two warm calls take the jit compile off the clock
+        for r in range(2):
+            wheel.query_group_by(f"{_base(r % nbases)}{{}}",
+                                 by=["route"], window=window,
+                                 percentiles=(0.5, 0.99))
+        gb = []
+        for r in range(20):
+            wheel._result_cache.clear()
+            t1 = time.perf_counter()
+            wheel.query_group_by(f"{_base(r % nbases)}{{}}",
+                                 by=["route"], window=window,
+                                 percentiles=(0.5, 0.99))
+            gb.append(time.perf_counter() - t1)
+
+        idx_stats = wheel.label_index.stats()
+        result["configs"][label] = {
+            "rows": rows,
+            "queries_served": served,
+            "qps": round(qps, 1),
+            "serve_median_us": round(float(np.median(lat)) * 1e6, 1),
+            "serve_p99_us": round(float(np.percentile(lat, 99)) * 1e6, 1),
+            "group_by_p99_us": round(
+                float(np.percentile(gb, 99)) * 1e6, 1
+            ),
+            "commits": commits,
+            "churn_evictions": lc.evicted_series,
+            "index_rebuilds": idx_stats["rebuilds"],
+            "selector_cache_hits": idx_stats["selector_cache_hits"],
+            "stale_serves": stale,
+            "zero_stale_serves": stale == 0,
+            "meets_1k_qps": qps >= QPS_TARGET,
+        }
+    # headline: the largest shape that ran
+    head = result["configs"][configs[-1][0] if (full or platform == "tpu")
+                             else "10000"]
+    result["query_serving_qps"] = head["qps"]
+    result["query_serve_p99_us"] = head["serve_p99_us"]
+    result["zero_stale_serves"] = all(
+        c["zero_stale_serves"] for c in result["configs"].values()
+    )
+    result["meets_slo"] = (
+        result["zero_stale_serves"]
+        and all(c["meets_1k_qps"] for c in result["configs"].values())
+    )
+    return result
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--duration", type=float, default=2.0)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--full", action="store_true",
+                    help="include the 100k-row point off-TPU")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    res = run(duration=args.duration, threads=args.threads,
+              full=args.full)
+    doc = json.dumps(res, indent=2)
+    print(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(doc + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,7 @@ import numpy as np
 from loghisto_tpu._native import fold_packed, pack_cells
 from loghisto_tpu.config import MetricConfig
 from loghisto_tpu.federation import wire
+from loghisto_tpu.labels.model import canonical_name
 from loghisto_tpu.obs.spans import LatencyHistogram, SpanRecorder
 from loghisto_tpu.ops.codec import encode_frame
 from loghisto_tpu.submitter import BACKLOG_SLOTS, BacklogSender
@@ -140,7 +141,15 @@ class FederationEmitter:
                 self._names_unsent.append((lid, name))
             return lid
 
-    def record(self, name: str, value: float) -> None:
+    def record(self, name: str, value: float, labels=None) -> None:
+        """``labels`` (optional mapping) canonicalizes AT RECORD TIME
+        (ISSUE 16): every permutation of the same label set becomes one
+        canonical ``name;k=v`` string and therefore ONE emitter-local
+        id, one dictionary-delta row, one aggregator registry row.  The
+        wire dictionary ships the canonical name as an opaque string —
+        no federation format change."""
+        if labels:
+            name = canonical_name(name, labels)
         self.record_batch(
             np.array([self.local_id(name)], dtype=np.int32),
             np.array([value], dtype=np.float32),

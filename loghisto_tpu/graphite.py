@@ -19,6 +19,13 @@ sorted by key for a deterministic wire format:
 (without the space — ``path;k=v <value> <ts>``).  The default (no tags,
 ``cockroach`` prefix) is byte-identical to the historical output, which
 tests/test_export.py pins.
+
+``labeled_tags=True`` (ISSUE 16) additionally re-renders canonical
+labeled metric names (``http.latency;route=/api`` + processed suffix)
+as native tagged series: the label pairs move out of the path and into
+``;k=v`` tags merged over the static set, so Graphite sees
+``cockroach.<host>.http.latency.99;route=/api``.  Off by default — the
+flat wire format stays byte-identical.
 """
 
 from __future__ import annotations
@@ -26,6 +33,7 @@ from __future__ import annotations
 import socket
 from typing import Mapping, Optional
 
+from loghisto_tpu.labels.model import split_processed
 from loghisto_tpu.metrics import ProcessedMetricSet
 
 
@@ -45,17 +53,34 @@ def graphite_protocol(
     prefix: str = "cockroach",
     hostname: str | None = None,
     tags: Optional[Mapping[str, str]] = None,
+    labeled_tags: bool = False,
 ) -> bytes:
-    """Serialize a ProcessedMetricSet for a Graphite Carbon instance."""
+    """Serialize a ProcessedMetricSet for a Graphite Carbon instance.
+    With ``labeled_tags`` labeled metric names render their label pairs
+    as per-line tagged-series tags (label values override a clashing
+    static tag — the row-level value is the more specific one)."""
     if hostname is None:
         hostname = socket.gethostname() or "unknown"
     ts = int(metric_set.time.timestamp())
     tag_str = _render_tags(tags)
-    lines = [
-        "%s.%s.%s%s %f %d\n"
-        % (prefix, hostname, metric.replace("_", "."), tag_str, value, ts)
-        for metric, value in metric_set.metrics.items()
-    ]
+    lines = []
+    for metric, value in metric_set.metrics.items():
+        line_tags = tag_str
+        if labeled_tags:
+            sp = split_processed(metric)
+            if sp is not None:
+                base, pairs, suffix = sp
+                merged = dict(tags or {})
+                merged.update(pairs)
+                line_tags = "".join(
+                    f";{k}={merged[k]}" for k in sorted(merged)
+                )
+                metric = base + suffix
+        lines.append(
+            "%s.%s.%s%s %f %d\n"
+            % (prefix, hostname, metric.replace("_", "."), line_tags,
+               value, ts)
+        )
     return "".join(lines).encode()
 
 
@@ -63,6 +88,7 @@ def make_graphite_serializer(
     prefix: str = "cockroach",
     hostname: str | None = None,
     tags: Optional[Mapping[str, str]] = None,
+    labeled_tags: bool = False,
 ):
     """Bind a custom prefix / static tag set into a serializer usable
     directly as a Submitter serializer (the constructor-configuration
@@ -70,7 +96,9 @@ def make_graphite_serializer(
     per interval."""
     _render_tags(tags)  # fail fast on malformed tags
     def serialize(metric_set: ProcessedMetricSet) -> bytes:
-        return graphite_protocol(metric_set, prefix, hostname, tags)
+        return graphite_protocol(
+            metric_set, prefix, hostname, tags, labeled_tags
+        )
     return serialize
 
 
@@ -82,6 +110,7 @@ def push_graphite(
     tags: Optional[Mapping[str, str]] = None,
     attempts: int = 3,
     backoff=None,
+    labeled_tags: bool = False,
 ) -> Optional[Exception]:
     """Serialize and deliver one metric set to a Carbon instance with
     the shared capped-exponential-backoff retry policy
@@ -90,7 +119,9 @@ def push_graphite(
     loop around send_once."""
     from loghisto_tpu.resilience.backoff import send_with_backoff
 
-    payload = graphite_protocol(metric_set, prefix, hostname, tags)
+    payload = graphite_protocol(
+        metric_set, prefix, hostname, tags, labeled_tags
+    )
     return send_with_backoff(
         "tcp", address, payload, attempts=attempts, backoff=backoff
     )

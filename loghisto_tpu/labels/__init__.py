@@ -1,0 +1,51 @@
+"""Label/tag data model over the flat registry (ISSUE 16).
+
+A labeled metric is one registry row under the canonical flat encoding
+``name;k1=v1;k2=v2`` (sorted keys) — ingest, fused commit, paged
+storage, lifecycle, checkpoints, and the federation dictionary all
+work unchanged underneath.  This package is the host-side layer on
+top: canonical encoding (``model``), the selector query language
+(``selector``), the generation-keyed inverted index that compiles
+selectors to row ids (``index``), and group_by rollup plumbing
+(``groupby``; imported lazily by consumers that need it — its oracle
+helpers reach into ops/stats).
+
+Everything exported here is jax-free, so the federation emitter can
+canonicalize labels at record time without an accelerator stack.
+"""
+
+from .model import (
+    LabelError,
+    LabelSet,
+    base_of,
+    canonical_name,
+    is_labeled,
+    labels_of,
+    parse_canonical,
+    split_processed,
+)
+from .selector import (
+    Matcher,
+    Selector,
+    SelectorError,
+    is_selector,
+    parse_selector,
+)
+from .index import LabelIndex
+
+__all__ = [
+    "LabelError",
+    "LabelSet",
+    "base_of",
+    "canonical_name",
+    "is_labeled",
+    "labels_of",
+    "parse_canonical",
+    "split_processed",
+    "Matcher",
+    "Selector",
+    "SelectorError",
+    "is_selector",
+    "parse_selector",
+    "LabelIndex",
+]

@@ -1,0 +1,160 @@
+"""group_by rollup results + the host-side merge oracle.
+
+``TimeWheel.query_group_by(selector, by=["route"])`` merges every
+matching labeled row into one histogram per distinct value-tuple of
+the ``by`` keys, ON DEVICE: one jitted gather + segment-sum + rank
+search (``ops.stats.make_group_query_fn``).  This module holds the
+host-facing result type, the group-key assignment (pure string work
+over canonical names), and the float64 merge oracle the parity tests
+compare the device rollup against.
+
+Merging is exact because log-bucket histograms merge by bucket-count
+addition (the same property the wheel's tier promotion relies on):
+no sketch error is introduced by grouping — per-group answer quality
+is bounded by the bucket width alone, and an equi-depth summary of a
+merged group is just its percentiles at ranks j/depth (equi-depth
+boundaries ARE quantiles), which is how ``depth=`` rides the same
+device dispatch as the percentile list.
+
+jax-free except for lazy oracle imports: the result/key helpers are
+importable next to the selector layer without touching an accelerator
+stack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from .model import parse_canonical
+
+GroupKey = Tuple[str, ...]
+
+
+@dataclasses.dataclass
+class GroupStats:
+    """Result of one group_by rollup.  ``groups`` maps the value-tuple
+    of the ``by`` keys (missing label -> "") to the merged stat dict
+    ({"count", "sum", "avg", "p50", ..., optionally "edges"}); ``sizes``
+    records how many rows merged into each group."""
+
+    time: _dt.datetime
+    window_s: float
+    covered_s: float
+    tier: int
+    slots: int
+    by: Tuple[str, ...]
+    groups: Dict[GroupKey, Dict[str, object]]
+    sizes: Dict[GroupKey, int]
+
+
+def group_key_for(name: str, by: Sequence[str]) -> GroupKey:
+    """The group a canonical name rolls into: its label values at the
+    ``by`` keys, missing labels reading as "" (Prometheus semantics —
+    the flat base row groups under ("", ..., "")), so group_by is total
+    over every selected row."""
+    labels = dict(parse_canonical(name)[1])
+    return tuple(labels.get(k, "") for k in by)
+
+
+def assign_groups(
+    matches: Sequence[Tuple[int, str]], by: Sequence[str]
+) -> Tuple[List[GroupKey], List[int]]:
+    """Deterministically number the groups of ``matches``: returns
+    (ordered distinct group keys, per-match group index).  Keys are
+    ordered by first appearance of ascending mid, so the device gids
+    and the host oracle agree without a sort."""
+    keys: List[GroupKey] = []
+    index: Dict[GroupKey, int] = {}
+    gids: List[int] = []
+    for _mid, name in matches:
+        gk = group_key_for(name, by)
+        gi = index.get(gk)
+        if gi is None:
+            gi = len(keys)
+            index[gk] = gi
+            keys.append(gk)
+        gids.append(gi)
+    return keys, gids
+
+
+def equidepth_ranks(depth: int) -> Tuple[float, ...]:
+    """The interior quantile ranks of an equi-depth summary: ``depth``
+    equal-count bins need the ``depth - 1`` boundaries at j/depth."""
+    if depth < 2:
+        raise ValueError("equi-depth summaries need depth >= 2")
+    return tuple(j / depth for j in range(1, depth))
+
+
+def merge_groups_host(
+    histograms: Mapping[str, Mapping[int, int]],
+    by: Sequence[str],
+    ps: Sequence[float],
+    precision: int,
+    value_of=None,
+) -> Dict[GroupKey, Dict[str, float]]:
+    """Float64 merge oracle: group the sparse per-name interval
+    histograms (name -> {codec bucket: count}) by ``by``, merge bucket
+    counts per group, and answer count/sum/percentiles via the host
+    reference selection rule (first bucket where float64(cum)/total >=
+    p, endpoints at first/last populated bucket — the same rule
+    ``percentiles_sparse`` implements).  The device group_by must pick
+    the SAME BUCKET for every (group, p) for dense-codec rows.
+
+    ``value_of(buckets) -> values`` maps codec bucket indices to
+    representative values; defaults to the host float64 decompress.
+    Parity tests pass the device's own float32 rep table
+    (``lambda b: np.asarray(bucket_representatives(bl, prec))[b + bl]``)
+    so bucket-identical selection becomes bit-identical float equality.
+    """
+    import numpy as np
+
+    from loghisto_tpu.ops.codec import decompress_np
+
+    if value_of is None:
+        value_of = lambda b: decompress_np(b, precision)  # noqa: E731
+
+    merged: Dict[GroupKey, Dict[int, int]] = {}
+    for name, buckets in histograms.items():
+        gk = group_key_for(name, by)
+        dst = merged.setdefault(gk, {})
+        for b, c in buckets.items():
+            dst[b] = dst.get(b, 0) + c
+    ps_arr = np.asarray(ps, dtype=np.float64)
+    out: Dict[GroupKey, Dict[str, float]] = {}
+    for gk, buckets in merged.items():
+        if not buckets:
+            continue
+        barr = np.asarray(sorted(buckets.keys()), dtype=np.int64)
+        carr = np.asarray(
+            [buckets[int(b)] for b in barr], dtype=np.int64
+        )
+        total_count = int(carr.sum())
+        if total_count == 0:
+            continue
+        values = np.asarray(value_of(barr), dtype=np.float64)
+        total_sum = float(np.dot(values, carr.astype(np.float64)))
+        cdf = np.cumsum(carr)
+        cdfn = cdf.astype(np.float64) / float(total_count)
+        pos = np.minimum(
+            np.searchsorted(cdfn, ps_arr, side="left"), len(barr) - 1
+        )
+        idx = np.where(
+            ps_arr <= 0, 0, np.where(ps_arr >= 1, len(barr) - 1, pos)
+        )
+        entry: Dict[str, float] = {
+            "count": float(total_count),
+            "sum": total_sum,
+            "avg": total_sum / total_count,
+        }
+        for p, v in zip(ps, values[idx]):
+            entry[_pct_key(float(p))] = float(v)
+        out[gk] = entry
+    return out
+
+
+def _pct_key(q: float) -> str:
+    # local copy of window.store.pct_key to keep this module import-light
+    s = f"{q * 100:.4f}".rstrip("0").rstrip(".")
+    return f"p{s}"

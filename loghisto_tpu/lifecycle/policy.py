@@ -30,8 +30,13 @@ OVERFLOW_PREFIX = "_overflow."
 def default_overflow_name(name: str) -> str:
     """``api.users.u12345.latency`` -> ``_overflow.api`` — one catch-all
     per top-level dot segment, so dashboards keep a per-subsystem total
-    after per-user identity is dropped."""
-    return OVERFLOW_PREFIX + name.split(".", 1)[0]
+    after per-user identity is dropped.  Labeled series (canonical
+    ``base;k=v`` rows, ISSUE 16) shed their label tail first:
+    ``http.latency;route=/api;user=u99`` folds into ``_overflow.http``,
+    so a cardinality explosion across label sets still lands in ONE
+    count-exact catch-all per subsystem."""
+    base = name.split(";", 1)[0]
+    return OVERFLOW_PREFIX + base.split(".", 1)[0]
 
 
 @dataclass(frozen=True)
@@ -44,6 +49,13 @@ class LifecycleConfig:
                         committed intervals (None disables TTL)
     max_live          — global live-series budget (None = unbounded)
     prefix_budgets    — glob -> live budget for the matching population
+    label_budgets     — base-name glob -> max live LABEL SETS per
+                        matching base (ISSUE 16): every label set is a
+                        registry row, so a runaway label dimension is
+                        the cardinality failure mode — an over-budget
+                        base sheds its least recently active label sets
+                        into the overflow catch-all, count-exactly,
+                        while flat series and other bases are untouched
     overflow_name     — victim name -> catch-all name its lifetime
                         state folds into
     protect           — globs never evicted (overflow names are always
@@ -62,6 +74,7 @@ class LifecycleConfig:
     ttl_intervals: Optional[int] = None
     max_live: Optional[int] = None
     prefix_budgets: Dict[str, int] = field(default_factory=dict)
+    label_budgets: Dict[str, int] = field(default_factory=dict)
     overflow_name: Callable[[str], str] = default_overflow_name
     protect: Tuple[str, ...] = ()
     check_every: int = 8
@@ -77,6 +90,9 @@ class LifecycleConfig:
         for pat, budget in self.prefix_budgets.items():
             if budget < 0:
                 raise ValueError(f"prefix budget {pat!r} is negative")
+        for pat, budget in self.label_budgets.items():
+            if budget < 0:
+                raise ValueError(f"label budget {pat!r} is negative")
         if self.check_every < 1:
             raise ValueError("check_every must be >= 1")
 
@@ -124,6 +140,21 @@ def decide_victims(
         over_budget(
             [e for e in live if fnmatch.fnmatch(e[1], pat)], budget
         )
+    # label-cardinality budgets (ISSUE 16): each budget caps the LABEL
+    # SETS of every base name matching its glob, independently per base
+    # — ``{"http.*": 100}`` lets http.latency AND http.bytes each keep
+    # 100 label sets.  Only labeled rows (canonical ``base;k=v``) count
+    # toward or fall to a label budget; the flat base row is exempt.
+    if config.label_budgets:
+        by_base: Dict[str, List[Tuple[int, str, int]]] = {}
+        for e in live:
+            if ";" not in e[1]:
+                continue
+            by_base.setdefault(e[1].split(";", 1)[0], []).append(e)
+        for pat, budget in config.label_budgets.items():
+            for base, pop in by_base.items():
+                if fnmatch.fnmatch(base, pat):
+                    over_budget(pop, budget)
     if config.max_live is not None:
         over_budget(list(live), config.max_live)
     return sorted(victims)

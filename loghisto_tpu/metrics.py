@@ -58,6 +58,7 @@ import numpy as np
 
 from loghisto_tpu.channel import Channel
 from loghisto_tpu.config import DEFAULT_PERCENTILES, MetricConfig
+from loghisto_tpu.labels.model import canonical_name
 from loghisto_tpu.obs.spans import NULL_RECORDER
 from loghisto_tpu.ops.codec import compress_np
 # ops.stats is imported lazily inside the functions that need it: this
@@ -447,6 +448,13 @@ class MetricSystem:
         self._thread_local = threading.local()
         self._shard_counter = itertools.count()
 
+        # labeled-handle cache (ISSUE 16): recorder()/timer()/
+        # counter_handle() calls with labels= resolve the canonical name
+        # and reuse ONE handle per (kind, label set), so hot loops pay
+        # the sort+validate exactly once per label set, not per call.
+        # Benign-race dict (worst case a duplicate handle build); capped.
+        self._labeled_handles: Dict[tuple, object] = {}
+
         # lifetime stores
         self._store_lock = threading.Lock()
         self._counter_store: Dict[str, int] = {}
@@ -521,8 +529,16 @@ class MetricSystem:
                 self._fast_fold()
         tl.fast_n = n
 
-    def counter(self, name: str, amount: int = 1) -> None:
-        """Record `amount` occurrences of an event (metrics.go:251-269)."""
+    def counter(
+        self, name: str, amount: int = 1,
+        labels: Optional[Mapping[str, str]] = None,
+    ) -> None:
+        """Record `amount` occurrences of an event (metrics.go:251-269).
+        ``labels`` dimension the counter: the increment lands on the
+        canonical row ``name;k1=v1;...`` (sorted keys — every insertion
+        order is ONE series; see loghisto_tpu/labels/model.py)."""
+        if labels:
+            name = canonical_name(name, labels)
         # fast path is exact for INTEGER |amount| <= 2^31 (2^21
         # records/fold x 2^31 < 2^53 float64-exact); bigger or
         # non-integer amounts take the Python path unchanged
@@ -621,10 +637,17 @@ class MetricSystem:
                     self._fast_folded.setdefault(names[fid], {}), ub, cnt
                 )
 
-    def histogram(self, name: str, value: float) -> None:
+    def histogram(
+        self, name: str, value: float,
+        labels: Optional[Mapping[str, str]] = None,
+    ) -> None:
         """Record one continuous value (metrics.go:273-295).  Values are
         appended raw; log-bucketing happens vectorized (at the buffer cap
-        or at collection, whichever comes first)."""
+        or at collection, whichever comes first).  ``labels`` dimension
+        the series (canonical-row encoding; prefer ``recorder(name,
+        labels=...)`` in hot loops — it prepays the canonicalization)."""
+        if labels:
+            name = canonical_name(name, labels)
         if self._fast_record is not None:
             self._fast_put(self._fast_buf, name, value)
             return
@@ -637,10 +660,15 @@ class MetricSystem:
             if len(buf) >= self.config.ingest_buffer_cap:
                 self._fold_shard_buffer(shard, name, buf)
 
-    def histogram_batch(self, name: str, values) -> None:
+    def histogram_batch(
+        self, name: str, values,
+        labels: Optional[Mapping[str, str]] = None,
+    ) -> None:
         """Record many values of one metric in a single call — the natural
         API for batch-oriented callers (no reference equivalent; the Go hot
         loop is per-sample)."""
+        if labels:
+            name = canonical_name(name, labels)
         shard = self._shard()
         with shard.lock:
             buf = shard.histograms.get(name)
@@ -659,34 +687,74 @@ class MetricSystem:
         _merge_counts(shard.bucket_counts.setdefault(name, {}), uniq, cnt)
         shard.histograms[name] = array("d")
 
-    def start_timer(self, name: str) -> "TimerToken | FastTimerToken":
+    def _labeled_handle(self, kind: str, name: str, labels, build):
+        """One cached handle per (kind, canonical labeled name): hot
+        loops calling ``recorder(name, labels={...})`` per request reuse
+        the same handle object — canonicalization (sort + validate) and
+        fast-path name resolution are paid once per label set."""
+        cname = canonical_name(name, labels)
+        key = (kind, cname)
+        handle = self._labeled_handles.get(key)
+        if handle is None:
+            handle = build(cname)
+            if len(self._labeled_handles) >= 4096:
+                self._labeled_handles.clear()
+            self._labeled_handles[key] = handle
+        return handle
+
+    def start_timer(
+        self, name: str, labels: Optional[Mapping[str, str]] = None,
+    ) -> "TimerToken | FastTimerToken":
         """Begin a named timing; stop() the returned token (metrics.go:232).
         With fast_ingest, the token's clock reads happen inside the C
         extension (FastTimerToken, same surface) — measured overhead
         drops ~2x."""
+        if labels:
+            name = canonical_name(name, labels)
         if self._fast_record is not None:
             return FastTimerToken(name, self, self._fast_stop_partial(name))
         return TimerToken(name, self)
 
-    def timer(self, name: str) -> "FastTimer | _PyTimer":
+    def timer(
+        self, name: str, labels: Optional[Mapping[str, str]] = None,
+    ) -> "FastTimer | _PyTimer":
         """Reusable per-name timer handle for hot loops (no per-
         measurement token allocation); see FastTimer.  Falls back to a
-        Python-clock handle without fast_ingest."""
+        Python-clock handle without fast_ingest.  With ``labels`` the
+        handle is cached per label set (one object per canonical row)."""
+        if labels:
+            return self._labeled_handle("timer", name, labels, self.timer)
         if self._fast_record is not None:
             return FastTimer(name, self, self._fast_stop_partial(name))
         return _PyTimer(name, self)
 
-    def recorder(self, name: str) -> "FastRecorder | _PyRecorder":
+    def recorder(
+        self, name: str, labels: Optional[Mapping[str, str]] = None,
+    ) -> "FastRecorder | _PyRecorder":
         """Reusable per-name histogram recorder for hot loops (name
         resolved once; record(value) is one C call + fold poll); see
-        FastRecorder.  Python fallback without fast_ingest."""
+        FastRecorder.  Python fallback without fast_ingest.  With
+        ``labels`` the handle is cached per label set, so per-request
+        ``recorder("http.latency", labels={"route": r})`` costs one dict
+        probe after the first call for each route."""
+        if labels:
+            return self._labeled_handle(
+                "recorder", name, labels, self.recorder
+            )
         if self._fast_record is not None:
             return FastRecorder(name, self, self._fast_record_partial(name))
         return _PyRecorder(name, self)
 
-    def counter_handle(self, name: str) -> "FastCounter | _PyCounter":
+    def counter_handle(
+        self, name: str, labels: Optional[Mapping[str, str]] = None,
+    ) -> "FastCounter | _PyCounter":
         """Reusable per-name counter handle for hot loops; see
-        FastCounter.  Python fallback without fast_ingest."""
+        FastCounter.  Python fallback without fast_ingest.  With
+        ``labels`` the handle is cached per label set."""
+        if labels:
+            return self._labeled_handle(
+                "counter", name, labels, self.counter_handle
+            )
         if self._fast_record is not None:
             return FastCounter(name, self, self._fast_add_partial(name))
         return _PyCounter(name, self)

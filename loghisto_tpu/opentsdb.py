@@ -6,6 +6,13 @@ Wire format (reference opentsdb.go:45-55): one line per metric,
 
 with a ``host=<hostname>`` tag by default.  Values use ``%f`` to match the
 reference's wire bytes.
+
+``labeled_tags=True`` (ISSUE 16) re-renders canonical labeled metric
+names as native OpenTSDB tag maps: the ``;k=v`` pairs leave the metric
+name and join the per-line tag set (appended key-sorted after the
+static tags, label values overriding a clashing static key), so the
+line becomes ``put http.latency_99 <ts> <v> host=h route=/api``.  Off
+by default — flat output stays byte-identical.
 """
 
 from __future__ import annotations
@@ -13,6 +20,7 @@ from __future__ import annotations
 import socket
 from typing import Mapping
 
+from loghisto_tpu.labels.model import split_processed
 from loghisto_tpu.metrics import ProcessedMetricSet
 
 
@@ -24,6 +32,7 @@ def opentsdb_protocol(
     metric_set: ProcessedMetricSet,
     tags: Mapping[str, str] | None = None,
     hostname: str | None = None,
+    labeled_tags: bool = False,
 ) -> bytes:
     """Serialize a ProcessedMetricSet for an OpenTSDB/KairosDB instance."""
     if hostname is None:
@@ -32,10 +41,20 @@ def opentsdb_protocol(
         tags = {"host": hostname}
     ts = int(metric_set.time.timestamp())
     wire_tags = _tags_to_wire(tags)
-    lines = [
-        "put %s %d %f %s\n" % (metric, ts, value, wire_tags)
-        for metric, value in metric_set.metrics.items()
-    ]
+    lines = []
+    for metric, value in metric_set.metrics.items():
+        line_tags = wire_tags
+        if labeled_tags:
+            sp = split_processed(metric)
+            if sp is not None:
+                base, pairs, suffix = sp
+                merged = dict(tags)
+                for k, v in sorted(dict(pairs).items()):
+                    merged.pop(k, None)
+                    merged[k] = v
+                line_tags = _tags_to_wire(merged)
+                metric = base + suffix
+        lines.append("put %s %d %f %s\n" % (metric, ts, value, line_tags))
     return "".join(lines).encode()
 
 
@@ -46,13 +65,14 @@ def push_opentsdb(
     hostname: str | None = None,
     attempts: int = 3,
     backoff=None,
+    labeled_tags: bool = False,
 ) -> "Exception | None":
     """Serialize and deliver one metric set to an OpenTSDB/KairosDB
     instance with the shared capped-exponential-backoff retry policy
     (resilience/backoff.py).  Returns the last error or None."""
     from loghisto_tpu.resilience.backoff import send_with_backoff
 
-    payload = opentsdb_protocol(metric_set, tags, hostname)
+    payload = opentsdb_protocol(metric_set, tags, hostname, labeled_tags)
     return send_with_backoff(
         "tcp", address, payload, attempts=attempts, backoff=backoff
     )

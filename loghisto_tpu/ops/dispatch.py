@@ -498,6 +498,15 @@ def resolve_storage_path(
 
     Returns ``(resolved, reason)`` — reason is None unless auto
     declined paged.
+
+    Labeled metrics (ISSUE 16): ``num_metrics`` counts REGISTRY ROWS,
+    and under the canonical label encoding every distinct label set of
+    a base name (``http.latency;code=500;route=/api``) is its own row —
+    so label cardinality, not base-name count, is what drives this
+    crossover.  A service with 50 base names and 10k live label sets is
+    a 10k-row deployment and typically wants paged storage; see
+    ``TPUMetricSystem.debug_dump()["labels"]["cardinality_by_prefix"]``
+    for the live per-prefix label population.
     """
     del platform  # both backends run on every platform (interpret tier)
     if storage == "auto":
@@ -543,7 +552,12 @@ def resolve_commit_path(
     by the metric axis) — "auto" then degrades to the fan-out, and an
     explicit "fused" raises with the reason string.  A legacy boolean
     ``mesh=True`` (no mesh object to inspect) is treated as a capable
-    sharded configuration."""
+    sharded configuration.
+
+    ``num_metrics`` here too counts registry rows under the canonical
+    label encoding (one row per live label set, see
+    loghisto_tpu/labels/model.py) — a labeled deployment's divisibility
+    and sizing checks run against label cardinality, not base names."""
     mesh_obj = None if isinstance(mesh, bool) or mesh is None else mesh
     reason = mesh_commit_incapability(mesh_obj, num_metrics)
     if path == "auto":

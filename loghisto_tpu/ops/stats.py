@@ -445,3 +445,56 @@ def make_snapshot_query_fn(
         )
 
     return query
+
+
+@functools.lru_cache(maxsize=None)
+def make_group_query_fn(
+    bucket_limit: int, precision: int = PRECISION, mesh=None
+):
+    """Jitted group_by rollup ``f(cdf, counts, sums, ids, gids, ps,
+    num_groups=G) -> stats per group``: gather the matched snapshot
+    rows, segment-sum them into per-group merged histograms, then run
+    the same row-stats selection as the sparse query — ONE dispatch for
+    the whole rollup (labels layer, ISSUE 16).
+
+    Merging is EXACT, not approximate: log-bucket histograms merge by
+    bucket-count addition, and a prefix sum is linear, so the sum of
+    CDF rows IS the CDF of the merged histogram (int32 exact; a merged
+    group's total must stay within int32, the same wire contract as a
+    single wheel slot).  Percentiles of the merged CDF therefore match
+    a host-side sparse merge oracle bit-for-bit for dense-codec rows
+    (tests/test_labels.py pins this).
+
+    ``num_groups`` is static (segment_sum needs a static segment
+    count); callers pad it to a power of two — padding ids point at row
+    0 and padding gids at a reserved dump segment that is sliced off
+    after readback — so drifting group counts reuse one executable per
+    (n_ids-bucket, groups-bucket, P) shape, exactly like the plan-cache
+    discipline of the sparse query path.  Sharding note: under a mesh
+    the gather ships only matched rows off their owning shards and the
+    tiny per-group results land replicated, same as the sparse query.
+    """
+    jit_kwargs = {}
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        jit_kwargs["out_shardings"] = NamedSharding(mesh, PartitionSpec())
+
+    @functools.partial(
+        jax.jit, static_argnames=("num_groups",), **jit_kwargs
+    )
+    def group_query(cdf, counts, sums, ids, gids, ps, *, num_groups):
+        gcdf = jax.ops.segment_sum(
+            cdf[ids], gids, num_segments=num_groups
+        )
+        gcounts = jax.ops.segment_sum(
+            counts[ids], gids, num_segments=num_groups
+        )
+        gsums = jax.ops.segment_sum(
+            sums[ids], gids, num_segments=num_groups
+        )
+        return snapshot_row_stats(
+            gcdf, gcounts, gsums, ps, bucket_limit, precision
+        )
+
+    return group_query

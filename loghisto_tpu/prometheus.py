@@ -30,6 +30,7 @@ import threading
 from typing import Optional
 
 from loghisto_tpu.channel import ChannelClosed, ResilientSubscription
+from loghisto_tpu.labels.model import parse_canonical, split_processed
 from loghisto_tpu.metrics import MetricSystem, ProcessedMetricSet
 
 logger = logging.getLogger("loghisto_tpu")
@@ -49,6 +50,27 @@ def _sanitize(name: str) -> str:
     return out
 
 
+def _escape_label_value(value: str) -> str:
+    """Exposition-format label-value escaping: backslash, double quote,
+    and newline (the canonical grammar forbids all three, but foreign
+    names parsed tolerantly may still carry them — escape, never drop)."""
+    return (
+        value.replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _label_str(pairs) -> str:
+    """``(("code","500"),("route","/api"))`` ->
+    ``code="500",route="/api"`` — canonical pairs arrive key-sorted, so
+    the rendering is deterministic.  Keys are sanitized (dots in the
+    canonical key grammar become ``_`` per the Prometheus data model)."""
+    return ",".join(
+        f'{_sanitize(k)}="{_escape_label_value(v)}"' for k, v in pairs
+    )
+
+
 def prometheus_exposition(
     metric_set: ProcessedMetricSet,
     include_timestamps: bool = False,
@@ -65,8 +87,28 @@ def prometheus_exposition(
         if include_timestamps else ""
     )
     plain: list[str] = []
-    summaries: dict[str, dict[str, float]] = {}
+    # family -> label-string ("" for flat) -> quantile -> value; one
+    # ``# TYPE`` line per family even when several label sets share it
+    summaries: dict[str, dict[str, dict[str, float]]] = {}
     for name, value in sorted(metric_set.metrics.items()):
+        sp = split_processed(name)
+        if sp is not None:
+            # labeled row (ISSUE 16): canonical ``base;k=v`` tail with
+            # the processed suffix appended after it — re-emit as native
+            # exposition labels, ``http_latency{route="/api"}``
+            base, pairs, suffix = sp
+            lstr = _label_str(pairs)
+            qs = suffix[1:]  # "_99" -> "99"
+            body = name[: -len(suffix)] if suffix else name
+            if qs in _SUFFIX_TO_Q and f"{body}_count" in metric_set.metrics:
+                summaries.setdefault(_sanitize(base), {}).setdefault(
+                    lstr, {}
+                ).setdefault(_SUFFIX_TO_Q[qs], value)
+            else:
+                plain.append(
+                    f"{_sanitize(base + suffix)}{{{lstr}}} {value}{stamp}"
+                )
+            continue
         m = _QUANTILE_SUFFIX.match(name)
         # only treat a _NN suffix as a quantile when its histogram-family
         # sibling `<base>_count` exists — a counter named `disk_90` must
@@ -76,14 +118,23 @@ def prometheus_exposition(
             q = _SUFFIX_TO_Q[m.group(2)]
             # keep-first on sanitization collisions: duplicate
             # family+quantile samples fail the whole scrape
-            summaries.setdefault(family, {}).setdefault(q, value)
+            summaries.setdefault(family, {}).setdefault(
+                "", {}
+            ).setdefault(q, value)
         else:
             plain.append(f"{_sanitize(name)} {value}{stamp}")
     lines = []
-    for family, quantiles in sorted(summaries.items()):
+    for family, by_labels in sorted(summaries.items()):
         lines.append(f"# TYPE {family} summary")
-        for q, value in sorted(quantiles.items(), key=lambda x: float(x[0])):
-            lines.append(f'{family}{{quantile="{q}"}} {value}{stamp}')
+        for lstr, quantiles in sorted(by_labels.items()):
+            sep = "," if lstr else ""
+            for q, value in sorted(
+                quantiles.items(), key=lambda x: float(x[0])
+            ):
+                lines.append(
+                    f'{family}{{{lstr}{sep}quantile="{q}"}} '
+                    f"{value}{stamp}"
+                )
     lines.extend(plain)
     return ("\n".join(lines) + "\n").encode()
 
@@ -114,15 +165,27 @@ def windowed_exposition(
     for window in windows:
         label = _window_label(window)
         res = wheel.query(pattern, window, percentiles=quantiles)
+        typed: set[str] = set()
         for name, entry in sorted(res.metrics.items()):
-            family = f"{_sanitize(name)}_w{label}"
-            lines.append(f"# TYPE {family} summary")
+            base, pairs = parse_canonical(name)
+            family = f"{_sanitize(base)}_w{label}"
+            lstr = _label_str(pairs)
+            sep = "," if lstr else ""
+            if family not in typed:
+                typed.add(family)
+                lines.append(f"# TYPE {family} summary")
             for q in quantiles:
                 key = f"{q * 100:.4f}".rstrip("0").rstrip(".")
                 value = entry[f"p{key}"]
-                lines.append(f'{family}{{quantile="{q:g}"}} {value}')
-            lines.append(f"{family}_count {entry['count']}")
-            lines.append(f"{family}_sum {entry['sum']}")
+                lines.append(
+                    f'{family}{{{lstr}{sep}quantile="{q:g}"}} {value}'
+                )
+            if lstr:
+                lines.append(f"{family}_count{{{lstr}}} {entry['count']}")
+                lines.append(f"{family}_sum{{{lstr}}} {entry['sum']}")
+            else:
+                lines.append(f"{family}_count {entry['count']}")
+                lines.append(f"{family}_sum {entry['sum']}")
     if not lines:
         return b""
     return ("\n".join(lines) + "\n").encode()

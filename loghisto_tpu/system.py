@@ -32,6 +32,7 @@ import numpy as np
 
 from loghisto_tpu.channel import Channel
 from loghisto_tpu.config import DEFAULT_PERCENTILES, MetricConfig
+from loghisto_tpu.labels import LabelIndex
 from loghisto_tpu.metrics import MetricSystem, ProcessedMetricSet, RawMetricSet
 from loghisto_tpu.parallel.aggregator import TPUAggregator
 
@@ -202,6 +203,11 @@ class TPUMetricSystem(MetricSystem):
             paged_config=paged_config,
         )
         self.aggregator.register_device_gauges(self)
+        # label layer (ISSUE 16): one inverted index over the shared
+        # registry serves selector queries and the labels.* gauges; the
+        # retention wheel (below) routes brace-syntax patterns to it
+        self.label_index = LabelIndex(self.aggregator.registry)
+        self.label_index.register_gauges(self)
         if self.resilience is not None:
             # before attach: the bridge/xfer threads must spawn supervised
             self.aggregator.supervisor = self.supervisor
@@ -230,6 +236,7 @@ class TPUMetricSystem(MetricSystem):
                     registry=self.aggregator.registry,
                     mesh=mesh,
                 )
+            self.retention.label_index = self.label_index
             if self.resilience is not None:
                 self.retention.supervisor = self.supervisor
                 self.retention.fault_injector = self.fault_injector
@@ -524,10 +531,21 @@ class TPUMetricSystem(MetricSystem):
                 "fallbacks": wheel.query_fallbacks,
                 "result_cache_hits": wheel.query_result_cache_hits,
                 "rows_fetched": wheel.query_rows_fetched,
+                "group_by_serves": wheel.query_group_serves,
                 "plan_cache_hits": wheel.plan_cache.hits,
                 "plan_cache_misses": wheel.plan_cache.misses,
                 "snapshot_age_intervals": wheel.snapshot_age_intervals(),
             }
+        # label layer: inverted-index size, selector-cache hit rate, and
+        # live label cardinality per prefix — the operator's view of
+        # which subsystem's label space is exploding (pair with the
+        # lifecycle label_budgets and resolve_storage_path's crossover:
+        # every label set is a registry row under the canonical
+        # ``name;k1=v1`` encoding)
+        li = self.label_index
+        labels_dump = li.stats()
+        labels_dump["cardinality_by_prefix"] = li.cardinality_by_prefix()
+        dump["labels"] = labels_dump
         if self.committer is not None:
             dump["commit"] = {
                 "intervals_committed": self.committer.intervals_committed,
@@ -626,6 +644,42 @@ class TPUMetricSystem(MetricSystem):
         advanced); see TimeWheel.query."""
         return self._require_retention().query(
             pattern, window, percentiles, tier
+        )
+
+    def query(
+        self,
+        selector: str = "*",
+        window: Optional[float] = None,
+        percentiles: Optional[Sequence[float]] = None,
+        tier: Optional[int] = None,
+    ):
+        """Selector-aware window query (ISSUE 16): ``selector`` is a
+        label selector (``http.latency{route=/api,code=~5..}``) or a
+        plain name glob — both resolve through the wheel's sparse
+        row-id serve path.  Same serving guarantees as query_window
+        (this method and query_window accept either syntax; query() is
+        the labeled-era spelling)."""
+        return self._require_retention().query(
+            selector, window, percentiles, tier
+        )
+
+    def query_group_by(
+        self,
+        selector: str,
+        by: Sequence[str],
+        window: Optional[float] = None,
+        percentiles: Optional[Sequence[float]] = None,
+        tier: Optional[int] = None,
+        depth: Optional[int] = None,
+    ):
+        """On-device group_by rollup: merge every row matching
+        ``selector`` into one histogram per distinct value-tuple of the
+        ``by`` label keys — one jitted gather + segment-sum dispatch,
+        exact merges (see TimeWheel.query_group_by).  ``depth=k`` adds
+        per-group equi-depth summaries (``edges``)."""
+        return self._require_retention().query_group_by(
+            selector, by, window=window, percentiles=percentiles,
+            tier=tier, depth=depth,
         )
 
     def window_rate(self, name: str, window: float) -> float:

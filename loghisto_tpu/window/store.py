@@ -46,9 +46,13 @@ import numpy as np
 
 from loghisto_tpu.config import MetricConfig
 from loghisto_tpu.channel import ChannelClosed, ResilientSubscription
+from loghisto_tpu.labels.groupby import GroupStats, assign_groups, \
+    equidepth_ranks
+from loghisto_tpu.labels.selector import is_selector, parse_selector
 from loghisto_tpu.metrics import MetricSystem, RawMetricSet
 from loghisto_tpu.obs.spans import NULL_RECORDER
-from loghisto_tpu.ops.stats import make_snapshot_query_fn
+from loghisto_tpu.ops.stats import make_group_query_fn, \
+    make_snapshot_query_fn
 from loghisto_tpu.ops.window import (
     make_window_snapshot_fn,
     make_window_stats_fn,
@@ -265,6 +269,14 @@ class TimeWheel:
         self._query_fn = make_snapshot_query_fn(
             config.bucket_limit, config.precision, mesh
         )
+        self._group_fn = make_group_query_fn(
+            config.bucket_limit, config.precision, mesh
+        )
+        # label layer (ISSUE 16): installed by TPUMetricSystem (or any
+        # owner sharing a LabelIndex over this wheel's registry); None
+        # means selector-syntax queries raise and plain globs are the
+        # only pattern language, exactly the pre-label behavior
+        self.label_index = None
         self._snapshot: Optional[Snapshot] = None
         self._pinned: List[float] = []      # pinned window seconds
         self._max_pinned = 8
@@ -275,6 +287,7 @@ class TimeWheel:
         self.query_fallbacks = 0         # locked-recompute fallbacks
         self.query_result_cache_hits = 0  # zero-dispatch host-cache hits
         self.query_rows_fetched = 0      # sparse rows read back (padded)
+        self.query_group_serves = 0      # group_by rollups served
 
         self._sharding = sharding
         self._tiers = [
@@ -603,6 +616,31 @@ class TimeWheel:
         self._glob_cache[pattern] = (gen, matches)
         return gen, matches
 
+    def _resolve_matches(self, pattern: str):
+        """Pattern -> (generation, ((mid, name), ...)) — the one seam
+        where the two query languages meet.  Brace syntax
+        (``base{k=v,...}``) routes to the label index's inverted-index
+        resolution; anything else stays on the wheel's original fnmatch
+        glob cache.  Both return the same (generation, matches) shape,
+        so the snapshot result cache keys on either uniformly."""
+        if is_selector(pattern):
+            idx = self.label_index
+            if idx is None:
+                raise ValueError(
+                    f"selector query {pattern!r} needs a LabelIndex "
+                    "(TPUMetricSystem installs one; standalone wheels "
+                    "set wheel.label_index = LabelIndex(wheel.registry))"
+                )
+            return idx.select(pattern, max_id=self.num_metrics)
+        return self._resolve_glob(pattern)
+
+    def _match_predicate(self, pattern: str):
+        """Name-level match test for the locked recompute path (must
+        agree with ``_resolve_matches`` row for row)."""
+        if is_selector(pattern):
+            return parse_selector(pattern).match_name
+        return lambda name: fnmatch.fnmatch(name, pattern)
+
     def lifecycle_invalidated_locked(self) -> None:
         """Called (store lock held) after lifecycle eviction or
         compaction mutated ring rows in place: the published snapshot
@@ -637,8 +675,12 @@ class TimeWheel:
         percentiles: Optional[Sequence[float]] = None,
         tier: Optional[int] = None,
     ) -> WindowStats:
-        """Sliding-window statistics for every metric matching the glob
-        ``pattern`` over the trailing ``window`` seconds.
+        """Sliding-window statistics for every metric matching
+        ``pattern`` over the trailing ``window`` seconds.  ``pattern``
+        is either a name glob (``http.*``) or, when a LabelIndex is
+        installed, a label selector (``http.latency{route=/api,
+        code=~5..}``) — both compile to the same sparse row-id serve
+        path.
 
         Served from the latest commit-time snapshot when one covers the
         window (the full written span, or an exactly pinned window):
@@ -687,7 +729,7 @@ class TimeWheel:
         the host result cache for this epoch, else run one sparse
         gather+searchsorted dispatch over the matched rows."""
         self.query_snapshot_hits += 1
-        gen, matches = self._resolve_glob(pattern)
+        gen, matches = self._resolve_matches(pattern)
         qkey = (pattern, window, ps, ti)
         cached = self._result_cache.get(qkey)
         if (
@@ -757,11 +799,12 @@ class TimeWheel:
             pcts = np.asarray(stats["percentiles"])
         names = self.registry.names()
         keys = [pct_key(p) for p in ps]
+        match = self._match_predicate(pattern)
         metrics: Dict[str, Dict[str, float]] = {}
         for mid, name in enumerate(names):
             if name is None:  # lifecycle-freed slot
                 continue
-            if mid >= len(counts) or not fnmatch.fnmatch(name, pattern):
+            if mid >= len(counts) or not match(name):
                 continue
             count = int(counts[mid])
             if count == 0:
@@ -781,6 +824,168 @@ class TimeWheel:
             tier=ti,
             slots=int(mask.sum()),
             metrics=metrics,
+        )
+
+    def query_group_by(
+        self,
+        selector: str,
+        by: Sequence[str],
+        window: Optional[float] = None,
+        percentiles: Optional[Sequence[float]] = None,
+        tier: Optional[int] = None,
+        depth: Optional[int] = None,
+    ) -> GroupStats:
+        """Merge every row matching ``selector`` into one histogram per
+        distinct value-tuple of the ``by`` label keys and answer
+        count/sum/avg/percentiles per group — ON DEVICE, one jitted
+        gather + segment-sum + rank search over the snapshot CDF rows
+        (``ops.stats.make_group_query_fn``).  The merge is exact:
+        log-bucket histograms merge by bucket addition and prefix sums
+        are linear, so grouping introduces zero sketch error (the host
+        oracle parity test pins bit-identity for dense rows).
+
+        ``selector`` takes either query language (brace selector or
+        plain glob); rows missing a ``by`` label group under "".
+        ``depth=k`` additionally returns each group's equi-depth
+        summary (the k-1 boundaries at ranks j/k) as ``edges`` —
+        equi-depth bin edges ARE quantiles, so the summary rides the
+        same dispatch.  Serving follows the sparse query path exactly:
+        warm repeats at an unchanged (epoch, generation) are
+        zero-dispatch host-cache hits; windows without a snapshot view
+        fall back to a locked one-off view build and auto-pin."""
+        by = tuple(str(k) for k in by)
+        if not by:
+            raise ValueError("group_by needs at least one label key")
+        ps = tuple(
+            float(p) for p in (
+                percentiles if percentiles is not None else self.percentiles
+            )
+        )
+        if any(not 0.0 <= p <= 1.0 for p in ps):
+            raise ValueError("percentiles must be in [0, 1]")
+        eps = equidepth_ranks(int(depth)) if depth is not None else ()
+        if window is None:
+            window = self._tiers[-1].span_intervals() * self.interval
+        window = float(window)
+        needed = max(1, math.ceil(window / self.interval))
+        ti = self._select_tier(needed) if tier is None else int(tier)
+        if not 0 <= ti < len(self._tiers):
+            raise ValueError(f"tier {ti} out of range")
+
+        with self.obs_recorder.span("query.serve"):
+            snap = self._snapshot  # atomic ref read; handle is immutable
+            view = None
+            if self.snapshots_enabled and snap is not None:
+                view = snap.tiers[ti].view_for(window)
+            gen, matches = self._resolve_matches(selector)
+            if view is not None:
+                qkey = ("#group_by", selector, by, window, ps, ti, depth)
+                cached = self._result_cache.get(qkey)
+                if (
+                    cached is not None
+                    and cached[0] == snap.epoch and cached[1] == gen
+                ):
+                    self.query_result_cache_hits += 1
+                    return cached[2]
+                gs = self._group_rollup(
+                    matches, by, ps, eps, ti,
+                    view.cdf, view.counts, view.sums,
+                    time=snap.time, window=window,
+                    covered=view.covered_s, slots=view.slots,
+                )
+                if len(self._result_cache) >= 128 \
+                        and qkey not in self._result_cache:
+                    self._result_cache.clear()
+                self._result_cache[qkey] = (snap.epoch, gen, gs)
+                return gs
+            # no materialized view: build a one-off CDF view for the
+            # window under the lock (the snapshot program reads the live
+            # ring), pin the window, and roll up outside the lock — the
+            # payload arrays are fresh program outputs, never donated
+            if self.snapshots_enabled:
+                self.pin_window(window)
+            self.query_fallbacks += 1
+            t = self._tiers[ti]
+            with self._lock:
+                mask = self._mask_locked(t, window)
+                covered = float(t.durations[mask].sum())
+                slots = int(mask.sum())
+                ts = self._last_time or _dt.datetime.now(
+                    tz=_dt.timezone.utc
+                )
+                payload = self._snapshot_fn(t.ring, mask[None])
+            return self._group_rollup(
+                matches, by, ps, eps, ti,
+                payload["cdf"][0], payload["counts"][0],
+                payload["sums"][0],
+                time=ts, window=window, covered=covered, slots=slots,
+            )
+
+    def _group_rollup(
+        self, matches, by: tuple, ps: tuple, eps: tuple, ti: int,
+        cdf, counts, sums, *, time, window: float, covered: float,
+        slots: int,
+    ) -> GroupStats:
+        """Shared device rollup over one CDF view: pad ids to the plan
+        grid (pow-2 rows, pow-2 segments, extra rows into a dump
+        segment sliced off after readback) and run the group kernel."""
+        self.query_group_serves += 1
+        keys = [pct_key(p) for p in ps]
+        groups: Dict[tuple, Dict[str, object]] = {}
+        sizes: Dict[tuple, int] = {}
+        if matches:
+            gkeys, gids = assign_groups(matches, by)
+            ng_real = len(gkeys)
+            ids_np = np.fromiter(
+                (mid for mid, _ in matches), dtype=np.int32,
+                count=len(matches),
+            )
+            padded, nb = QueryPlanCache.pad_ids(ids_np)
+            # pad rows land in segment ng_real (the dump group); the
+            # static segment count rounds up to a power of two so
+            # drifting group counts reuse one executable
+            ng = 1 if ng_real < 1 else 1 << ng_real.bit_length()
+            gids_pad = np.full(nb, ng_real, dtype=np.int32)
+            gids_pad[: len(gids)] = gids
+            all_ps = np.asarray(ps + eps, dtype=np.float32)
+            self.plan_cache.note((ti, "group", ng), nb, len(all_ps))
+            out = self._group_fn(
+                cdf, counts, sums, padded, gids_pad, all_ps,
+                num_groups=ng,
+            )
+            self.query_rows_fetched += nb
+            gcounts = np.asarray(out["counts"])
+            gsums = np.asarray(out["sums"])
+            gpcts = np.asarray(out["percentiles"])
+            gsizes = np.bincount(
+                np.asarray(gids, dtype=np.int64), minlength=ng_real
+            )
+            for gi, gk in enumerate(gkeys):
+                count = int(gcounts[gi])
+                if count == 0:
+                    continue
+                entry: Dict[str, object] = {
+                    "count": float(count),
+                    "sum": float(gsums[gi]),
+                    "avg": float(gsums[gi]) / count,
+                }
+                for key, value in zip(keys, gpcts[gi][: len(ps)]):
+                    entry[key] = float(value)
+                if eps:
+                    entry["edges"] = [
+                        float(v) for v in gpcts[gi][len(ps):]
+                    ]
+                groups[gk] = entry
+                sizes[gk] = int(gsizes[gi])
+        return GroupStats(
+            time=time or _dt.datetime.now(tz=_dt.timezone.utc),
+            window_s=window,
+            covered_s=covered,
+            tier=ti,
+            slots=slots,
+            by=by,
+            groups=groups,
+            sizes=sizes,
         )
 
     def window_counter(
@@ -843,6 +1048,10 @@ class TimeWheel:
         ms.register_gauge_func(
             "commit.query_ResultCacheHits",
             lambda: float(self.query_result_cache_hits),
+        )
+        ms.register_gauge_func(
+            "commit.query_GroupByServed",
+            lambda: float(self.query_group_serves),
         )
 
     # -- subscription bridge ------------------------------------------- #

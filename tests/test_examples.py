@@ -182,3 +182,25 @@ def test_federation_demo_example_runs():
     # exact conservation across the whole fleet, 0 decode errors
     assert "0 decode errors" in out
     assert "conservation exact across 12 emitter processes: OK" in out
+
+
+def test_labeled_metrics_example_runs():
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "labeled_metrics.py")],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    # permuted label dicts canonicalize to ONE registry row
+    assert ("two permuted label dicts -> rows: "
+            "['http.latency;code=500;route=/api']") in out
+    assert "backfilled 60 intervals across 6 label sets" in out
+    # selector queries resolve through the inverted index
+    assert "code=~5.. matched 3 rows" in out
+    # device group_by merged both codes per route
+    assert "route=/api" in out and "rows=2" in out
+    # the exposition excerpt carries native labels
+    assert 'http_latency_w30s{code="500",route="/api",quantile="0.99"}' \
+        in out
+    assert "cardinality by prefix: {'http': 6}" in out
