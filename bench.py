@@ -649,6 +649,36 @@ def main() -> None:
         print(f"bench: fused-ingest stage failed: {e}", file=sys.stderr)
     ready11.set()
 
+    # FUSED_MIN_BATCH calibration (r17 satellite): measure the fused
+    # kernel's batch-size crossover on THIS platform and write it into
+    # the committed dispatch thresholds file, platform-scoped — the
+    # r13 CPU-interpret sweep must never set the TPU default.  A sweep
+    # that finds no crossover (interpret-mode CPU: the fused kernel
+    # never beats scatter) writes nothing; the baked fallback stands.
+    ready11b = _start_watchdog(300.0, on_timeout=lambda: print(
+        json.dumps(result), flush=True
+    ))
+    try:
+        from benchmarks.fused_ingest_bench import (
+            derive_fused_min_batch, run_crossover, write_fused_min_batch,
+        )
+
+        if platform == "tpu":
+            cx = run_crossover(reps=3)
+        else:
+            cx = run_crossover(num_metrics=1024, bucket_limit=512,
+                               batches=(1 << 14, 1 << 16), reps=1)
+        result["fused_min_batch_crossover"] = cx["measured_crossover_batch"]
+        update = derive_fused_min_batch(cx)
+        if update is not None:
+            path = write_fused_min_batch(
+                update, source=f"bench.py crossover sweep ({platform})"
+            )
+            result["fused_min_batch_written"] = path
+    except Exception as e:  # never let the extra metric kill the bench
+        print(f"bench: fused-min-batch stage failed: {e}", file=sys.stderr)
+    ready11b.set()
+
     # paged-storage headline (benchmarks/paged_store.py has the full
     # three-config wire comparison and the 1M-row HBM math): commit H2D
     # bytes per interval under the r14 paged backend at the largest wire
@@ -678,6 +708,39 @@ def main() -> None:
     except Exception as e:  # never let the extra metric kill the bench
         print(f"bench: paged-storage stage failed: {e}", file=sys.stderr)
     ready12.set()
+
+    # direct-to-paged fused ingest headline (benchmarks/
+    # fused_paged_bench.py has the mesh resolution table and the
+    # two-stage comparison): the r17 one-dispatch
+    # compress->encode->translate->scatter route's samples/s against the
+    # pool's HBM-RMW roofline, and the paged-path interval dispatch
+    # budget.  On CPU the Pallas scatter tier is interpret-mode
+    # (seconds per dispatch), so the shape shrinks and the fraction
+    # only calibrates the pipeline; a --tpu capture reruns the full
+    # shape.
+    ready12b = _start_watchdog(600.0, on_timeout=lambda: print(
+        json.dumps(result), flush=True
+    ))
+    try:
+        from benchmarks.fused_paged_bench import run as fused_paged_run
+
+        if platform == "tpu":
+            fpd = fused_paged_run(num_metrics=1 << 16, bucket_limit=4096,
+                                  batch=1 << 20, reps=3)
+        else:
+            fpd = fused_paged_run(num_metrics=1024, bucket_limit=512,
+                                  batch=1 << 14, reps=2, pool_pages=4096)
+        result["fused_paged_sps"] = fpd["fused"]["samples_per_s"]
+        result["paged_roofline_fraction"] = (
+            None if fpd["fused"]["suspect"]
+            else fpd["fused"]["roofline_fraction"]
+        )
+        result["fused_paged_suspect"] = fpd["fused"]["suspect"]
+        result["fused_paged_interpret"] = fpd["pallas_interpret"]
+        result["fused_paged_over_two_stage"] = fpd["fused_over_two_stage"]
+    except Exception as e:  # never let the extra metric kill the bench
+        print(f"bench: fused-paged stage failed: {e}", file=sys.stderr)
+    ready12b.set()
 
     # label-serving headline (benchmarks/query_serving.py has the full
     # closed-loop table): sustained selector QPS and serve p99 under

@@ -161,6 +161,51 @@ def run_crossover(num_metrics: int = 10_000, bucket_limit: int = 4_096,
     }
 
 
+def derive_fused_min_batch(crossover_result: dict) -> dict | None:
+    """Map a measured crossover sweep (``run_crossover``'s output) to a
+    platform-scoped thresholds-file update, or None when the sweep never
+    found a crossover (the fused kernel never beat scatter at any swept
+    batch — true of interpret-mode CPU runs, where writing a number
+    would calibrate the TPU default from an untrustworthy measurement,
+    the exact misread the r17 satellite exists to stop)."""
+    batch = crossover_result.get("measured_crossover_batch")
+    platform = crossover_result.get("platform")
+    if not isinstance(batch, int) or isinstance(batch, bool) or batch < 1:
+        return None
+    if not isinstance(platform, str) or not platform:
+        return None
+    return {"fused_min_batch_by_platform": {platform: batch}}
+
+
+def write_fused_min_batch(update: dict, path: str | None = None,
+                          source: str | None = None) -> str:
+    """Merge a ``derive_fused_min_batch`` update into the committed
+    dispatch thresholds file (creating it if absent), preserving every
+    other key — the same file analyze_capture.py --emit-thresholds
+    owns, so a capture and this calibration coexist.  Returns the path
+    written."""
+    from loghisto_tpu.ops import dispatch
+
+    if path is None:
+        path = dispatch.THRESHOLDS_FILE
+    table = {}
+    try:
+        with open(path) as f:
+            loaded = json.load(f)
+        if isinstance(loaded, dict):
+            table = loaded
+    except (OSError, ValueError):
+        pass
+    per_platform = dict(table.get("fused_min_batch_by_platform") or {})
+    per_platform.update(update["fused_min_batch_by_platform"])
+    table["fused_min_batch_by_platform"] = per_platform
+    if source is not None:
+        table["source"] = source
+    with open(path, "w") as f:
+        f.write(json.dumps(table, indent=1) + "\n")
+    return path
+
+
 def run_overlap(num_metrics: int = 4_096, bucket_limit: int = 512,
                 batch: int = 1 << 15, rounds: int = 3,
                 super_chunks_per_round: int = 4) -> dict:

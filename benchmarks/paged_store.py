@@ -4,18 +4,23 @@ and live-rows-per-GiB of HBM (the 1M-rows-per-chip budget math).
 
 Two honest mechanisms, measured separately:
 
-  * **Wire (H2D bytes/interval)** — the paged backend PINS the packed
-    sparse-triple transport, so every interval ships 12 bytes per
-    *occupied cell*.  The dense default starts on the raw transport
-    (8 bytes per *sample*) and its one-shot density probe inspects a
-    64Ki-sample prefix: at 100k+ live rows the prefix cannot see
+  * **Wire (H2D bytes/interval)** — the paged backend (on its r14
+    host-fold route) PINS the packed sparse-triple transport, so every
+    interval ships 12 bytes per *occupied cell*.  The dense default
+    starts on the raw transport (8 bytes per *sample*); at the time of
+    the r14 capture its one-shot density probe inspected only a
+    64Ki-sample prefix, which at 100k+ live rows cannot see
     within-interval cell duplication (the prefix touches each cell at
-    most ~once), so the probe reads density ~0.9 and the dense default
-    stays raw for the whole run — it ships every duplicate sample.  The
-    dense aggregator CAN be pinned to the sparse transport explicitly;
-    that line is reported too (wire parity with paged, up to commit
-    padding), so the reduction is attributed to what the r14 storage
-    resolver changes about the DEFAULT, not to hiding PR 6.
+    most ~once) — the probe read density ~0.9 and the dense default
+    stayed raw for the whole run, shipping every duplicate sample.
+    (r17 fixed that misread: the probe now folds unique cells over the
+    WHOLE item, so a rerun of the 100k point switches the dense
+    default to sparse and narrows the headline gap to roughly the
+    explicitly-pinned line below.)  The dense aggregator CAN be pinned
+    to the sparse transport explicitly; that line is reported too
+    (wire parity with paged, up to commit padding), so the reduction
+    is attributed to what the r14 storage resolver changes about the
+    DEFAULT, not to hiding PR 6.
   * **HBM (live rows/GiB)** — dense spends ``B x 4`` bytes per row
     regardless of occupancy (8193 buckets -> 32 KiB/row, ~32.8k rows
     per GiB); the paged pool spends ~1 page per live sparse row plus

@@ -1,4 +1,5 @@
-"""Automatic ingest-path selection (VERDICT r1 item 6).
+"""Automatic ingest/storage/commit path selection (VERDICT r1 item 6,
+unified capability table r17).
 
 Six bit-identical device accumulation kernels exist (scatter / sort-dedup
 scatter / scan-based sort-dedup ("sortscan") / one-hot MXU matmul /
@@ -24,12 +25,33 @@ cardinality where Zipf batches concentrate on hot rows, and why the
 fused Pallas row kernel wins the single-metric case outright.  On CPU
 the scatter path wins everywhere measured (BENCH_r01 table), so auto ==
 scatter there.
+
+Capability table (r17)
+----------------------
+
+Through r16 this module grew three independent contender ladders —
+``fused_ingest_incapability`` (ingest), ``paged_storage_incapability``
+(storage), and ``mesh_commit_incapability`` (commit) — each a
+copy-pasted walk of if-return-reason checks.  The r17 direct-to-paged
+fused kernel would have been a fourth.  They are now rows of ONE
+``CAPABILITY_TABLE``: each (axis, contender) maps to an ordered tuple
+of edges, each edge a named check returning its human-readable reason
+string (or None), with policy edges (amortization crossovers — things
+an explicit selection is allowed to override) flagged so
+``crossover=False`` skips exactly those.  The public
+``*_incapability`` functions are thin views over the table — every
+pre-r17 reason string survives verbatim (tests pin them) — and
+``resolve_full_path`` walks the single ``DEGRADATION_ORDER`` to
+resolve a complete (transport, ingest, storage, commit) path with the
+per-edge reasons of everything it declined along the way.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json as _json
 import os as _os
+from typing import Callable, Dict, NamedTuple, Optional, Tuple
 
 # Measured crossover (device_paths.json): sort-dedup overtakes plain
 # scatter between M=256 and M=10000; the conservative switch point keeps
@@ -59,6 +81,16 @@ HIGH_CARDINALITY_KERNEL = "sort"
 # winner.  Capture-overridable.
 FUSED_INGEST = True
 
+# Whether auto considers the r17 direct-to-paged fused kernel
+# (ops/fused_ingest.fused_paged_ingest_batch: compress -> log-bucket ->
+# codec-encode -> page-translate -> scatter-add straight into the
+# donated page pool, ONE dispatch per batch, no dense [M, B] tensor and
+# no host fold on the hot path).  Only meaningful when storage resolves
+# to "paged"; when fused_paged_incapability names a blocker the paged
+# path degrades to the pre-r17 two-stage route (host fold + translate +
+# packed pool commit).  Capture-overridable.
+FUSED_PAGED = True
+
 # Minimum batch the fused kernel's XLA sort+layout preprocess amortizes
 # over: below this the plain scatter's per-sample random access is
 # cheaper than sorting the batch and padding block segments to
@@ -67,6 +99,14 @@ FUSED_INGEST = True
 # "crossover" section); a hardware capture retunes it via the committed
 # JSON like every other threshold.
 FUSED_MIN_BATCH = 1 << 17
+
+# Per-platform measured crossover overrides for FUSED_MIN_BATCH
+# (r17 satellite): the r13 CPU-interpret sweep is NOT trustworthy for
+# the TPU default, so calibration writes a platform-scoped entry
+# ("fused_min_batch_by_platform": {"cpu": ..., "tpu": ...}) and the
+# capability check consults the running platform's entry, falling back
+# to the baked FUSED_MIN_BATCH when the platform was never measured.
+FUSED_MIN_BATCH_BY_PLATFORM: Dict[str, int] = {}
 
 # Metric rows per fused-kernel accumulator block; mirrored from
 # fused_ingest.ROWS_TILE without importing jax (this module must stay
@@ -141,8 +181,8 @@ def _load_thresholds() -> None:
     global SORT_MIN_METRICS, PALLAS_SINGLE_METRIC, THRESHOLDS_SOURCE
     global HIGH_CARDINALITY_KERNEL, FUSED_COMMIT
     global SPARSE_DENSITY_CROSSOVER, SPARSE_KERNEL
-    global FUSED_INGEST, FUSED_MIN_BATCH
-    global PAGED_STORAGE, PAGED_MIN_METRICS
+    global FUSED_INGEST, FUSED_MIN_BATCH, FUSED_MIN_BATCH_BY_PLATFORM
+    global PAGED_STORAGE, PAGED_MIN_METRICS, FUSED_PAGED
     try:
         with open(THRESHOLDS_FILE) as f:
             table = _json.load(f)
@@ -188,6 +228,20 @@ def _load_thresholds() -> None:
     if isinstance(fmb, int) and not isinstance(fmb, bool) and fmb >= 1:
         FUSED_MIN_BATCH = fmb
         applied = True
+    fmbp = table.get("fused_min_batch_by_platform")
+    if isinstance(fmbp, dict):
+        clean = {
+            str(k): v
+            for k, v in fmbp.items()
+            if isinstance(v, int) and not isinstance(v, bool) and v >= 1
+        }
+        if clean:
+            FUSED_MIN_BATCH_BY_PLATFORM = clean
+            applied = True
+    fp = table.get("fused_paged")
+    if isinstance(fp, bool):
+        FUSED_PAGED = fp
+        applied = True
     pst = table.get("paged_storage")
     if isinstance(pst, bool):
         PAGED_STORAGE = pst
@@ -203,12 +257,298 @@ def _load_thresholds() -> None:
 _load_thresholds()
 
 
+def fused_min_batch_for(platform: Optional[str]) -> int:
+    """The effective fused-kernel batch crossover for a platform: the
+    calibrated per-platform entry when a measured sweep wrote one
+    (bench.py's calibration stage / a hardware capture), else the baked
+    FUSED_MIN_BATCH fallback.  ``platform=None`` (callers that never
+    learned the backend) always gets the fallback."""
+    if platform is not None:
+        v = FUSED_MIN_BATCH_BY_PLATFORM.get(platform)
+        if isinstance(v, int) and not isinstance(v, bool) and v >= 1:
+            return v
+    return FUSED_MIN_BATCH
+
+
+# -- the capability table -------------------------------------------- #
+
+@dataclasses.dataclass(frozen=True)
+class PathContext:
+    """Everything a capability edge may inspect — one context shape for
+    every axis, so edges compose across contenders (the fused_paged row
+    reuses the fused-ingest and paged-storage edges verbatim)."""
+
+    num_metrics: int = 0
+    num_buckets: Optional[int] = None
+    platform: Optional[str] = None
+    batch_size: Optional[int] = None
+    mesh: bool = False
+    mesh_obj: object = None  # the Mesh, when the caller has one
+    transport: str = "sparse"
+    acc_dtype: str = "int32"
+    fused_ok: bool = False  # a capable fused_paged path relaxes edges
+
+
+class CapabilityEdge(NamedTuple):
+    """One named check of one contender.  ``policy=True`` marks
+    performance policy (amortization crossovers, platform preferences)
+    that ``crossover=False`` — an explicit operator selection — may
+    override; ``policy=False`` edges are correctness and always apply.
+    ``check(ctx)`` returns the human-readable reason string (what the
+    operator sees in the auto-degrade log or the explicit-path raise)
+    or None when the edge passes."""
+
+    name: str
+    policy: bool
+    check: Callable[[PathContext], Optional[str]]
+
+
+# -- ingest:fused edges (r13 strings, preserved verbatim) --
+
+
+def _ck_fused_mesh(ctx: PathContext) -> Optional[str]:
+    if ctx.mesh:
+        return (
+            "mesh shape: the fused kernel does not run inside a "
+            "shard_map-embedded step (pallas_call under shard_map is not "
+            "hardware-validated; the sharded path keeps its dispatched "
+            "local fold)"
+        )
+    return None
+
+
+def _ck_fused_rows_tile(ctx: PathContext) -> Optional[str]:
+    if ctx.num_metrics % FUSED_ROWS_TILE:
+        return (
+            f"mesh shape: num_metrics={ctx.num_metrics} does not divide by "
+            f"the fused kernel's {FUSED_ROWS_TILE}-row metric tile"
+        )
+    return None
+
+
+def _ck_fused_dtype(ctx: PathContext) -> Optional[str]:
+    if ctx.acc_dtype != "int32":
+        return (
+            f"dtype: accumulator dtype {ctx.acc_dtype} is not int32 — the "
+            "fused kernel's per-tile f32 one-hot accumulation is "
+            "integer-exact only against the int32 dense layout"
+        )
+    return None
+
+
+def _ck_fused_batch(ctx: PathContext) -> Optional[str]:
+    min_batch = fused_min_batch_for(ctx.platform)
+    if ctx.batch_size is None:
+        return (
+            "batch too small: batch size unknown, cannot prove the "
+            f"sort+layout preprocess amortizes (needs >= {min_batch} "
+            "samples/batch)"
+        )
+    if ctx.batch_size < min_batch:
+        return (
+            f"batch too small: {ctx.batch_size} samples/batch does not "
+            "amortize the fused kernel's sort+layout preprocess "
+            f"(measured crossover {min_batch})"
+        )
+    return None
+
+
+# -- storage:paged edges (r14 strings, preserved verbatim) --
+
+
+def _ck_paged_mesh(ctx: PathContext) -> Optional[str]:
+    if ctx.mesh:
+        return (
+            "mesh shape: paged storage does not run on a sharded mesh "
+            "(the page pool is a single-device arena; the page table's "
+            "slot ids are meaningless across shards — the sharded path "
+            "keeps its dense row-sharded accumulator)"
+        )
+    return None
+
+
+def _ck_paged_transport(ctx: PathContext) -> Optional[str]:
+    allowed = ("sparse", "auto", "raw") if ctx.fused_ok else ("sparse", "auto")
+    if ctx.transport not in allowed:
+        return (
+            f"transport: paged storage commits through the packed "
+            f"[n,3] sparse-triple fold (transport='sparse'); "
+            f"transport={ctx.transport!r} ships whole batches with no host "
+            "fold, so there is no translate step to route cells through "
+            "the page table"
+        )
+    return None
+
+
+def _ck_paged_bucket_axis(ctx: PathContext) -> Optional[str]:
+    if ctx.num_buckets is not None and ctx.num_buckets < PAGE_SIZE:
+        return (
+            f"bucket axis: num_buckets={ctx.num_buckets} is smaller than "
+            f"one {PAGE_SIZE}-bucket page — the dense row is already "
+            "cheaper than any page table"
+        )
+    return None
+
+
+def _ck_paged_crossover(ctx: PathContext) -> Optional[str]:
+    if ctx.num_metrics < PAGED_MIN_METRICS:
+        return (
+            f"below crossover: {ctx.num_metrics} metric rows — the dense "
+            f"accumulator fits HBM trivially below {PAGED_MIN_METRICS} "
+            "rows and its donated in-place commit wins (PAGED_STORE_r14)"
+        )
+    return None
+
+
+# -- commit:fused edges (mesh strings, preserved verbatim) --
+
+
+def _ck_commit_axes(ctx: PathContext) -> Optional[str]:
+    mesh = ctx.mesh_obj
+    if mesh is None:
+        return None
+    from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
+
+    axes = tuple(getattr(mesh, "axis_names", ()))
+    if STREAM_AXIS not in axes or METRIC_AXIS not in axes:
+        return (
+            f"mesh axes {axes!r} are not the ('{STREAM_AXIS}', "
+            f"'{METRIC_AXIS}') commit layout"
+        )
+    return None
+
+
+def _ck_commit_rows(ctx: PathContext) -> Optional[str]:
+    mesh = ctx.mesh_obj
+    if mesh is None:
+        return None
+    from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
+
+    axes = tuple(getattr(mesh, "axis_names", ()))
+    if STREAM_AXIS not in axes or METRIC_AXIS not in axes:
+        return None  # the axes edge already declined
+    n_metric = mesh.shape[METRIC_AXIS]
+    if ctx.num_metrics and ctx.num_metrics % n_metric:
+        return (
+            f"num_metrics={ctx.num_metrics} rows don't shard evenly over "
+            f"the {n_metric}-way metric axis"
+        )
+    return None
+
+
+# -- ingest:fused_paged edges (r17) --
+
+
+def _ck_fused_paged_switch(ctx: PathContext) -> Optional[str]:
+    if not FUSED_PAGED:
+        return (
+            "disabled: fused_paged is off in the threshold table "
+            f"({THRESHOLDS_SOURCE})"
+        )
+    return None
+
+
+def _ck_fused_paged_transport(ctx: PathContext) -> Optional[str]:
+    if ctx.transport not in ("raw", "auto"):
+        return (
+            "transport: the direct-to-paged fused kernel ingests RAW "
+            "samples (compress, codec-encode, and page-translate all "
+            f"happen on device in one dispatch); transport="
+            f"{ctx.transport!r} folds cells on host first, leaving the "
+            "one-dispatch path nothing to fuse — the folded route keeps "
+            "the translate + packed pool commit"
+        )
+    return None
+
+
+def _ck_fused_paged_platform(ctx: PathContext) -> Optional[str]:
+    if ctx.platform is not None and ctx.platform != "tpu":
+        return (
+            f"platform: {ctx.platform} — auto only picks the direct-to-"
+            "paged fused kernel on TPU (the interpret-mode Pallas tier is "
+            "parity-only; explicit selection remains the opt-in)"
+        )
+    return None
+
+
+# The table: (axis, contender) -> ordered edges.  The fused_paged row is
+# COMPOSED from the fused-ingest and paged-storage edges plus its own —
+# the refactor's point: a new contender is a new row, not a fourth
+# copy-pasted ladder.  Note what it does NOT inherit: the rows_tile and
+# dtype edges (the paged kernel is per-sample gather + per-cell DMA —
+# no ROWS_TILE accumulator blocks, and the pool is int32 by
+# construction), and the sparse-transport edge (it exists to ingest raw
+# batches directly).
+CAPABILITY_TABLE: Dict[Tuple[str, str], Tuple[CapabilityEdge, ...]] = {
+    ("ingest", "fused"): (
+        CapabilityEdge("mesh", False, _ck_fused_mesh),
+        CapabilityEdge("rows_tile", False, _ck_fused_rows_tile),
+        CapabilityEdge("dtype", False, _ck_fused_dtype),
+        CapabilityEdge("batch", True, _ck_fused_batch),
+    ),
+    ("storage", "paged"): (
+        CapabilityEdge("mesh", False, _ck_paged_mesh),
+        CapabilityEdge("transport", False, _ck_paged_transport),
+        CapabilityEdge("bucket_axis", False, _ck_paged_bucket_axis),
+        CapabilityEdge("crossover", True, _ck_paged_crossover),
+    ),
+    ("commit", "fused"): (
+        CapabilityEdge("mesh_axes", False, _ck_commit_axes),
+        CapabilityEdge("rows", False, _ck_commit_rows),
+    ),
+    ("ingest", "fused_paged"): (
+        CapabilityEdge("switch", True, _ck_fused_paged_switch),
+        CapabilityEdge("mesh", False, _ck_fused_mesh),
+        CapabilityEdge("pool_mesh", False, _ck_paged_mesh),
+        CapabilityEdge("bucket_axis", False, _ck_paged_bucket_axis),
+        CapabilityEdge("transport", False, _ck_fused_paged_transport),
+        CapabilityEdge("platform", True, _ck_fused_paged_platform),
+        CapabilityEdge("batch", True, _ck_fused_batch),
+    ),
+}
+
+# The single degradation order per axis — the ladder every "auto"
+# resolution walks, most-capable contender first.  (The ingest ladder's
+# sort entry is HIGH_CARDINALITY_KERNEL at resolve time; "scatter" is
+# the unconditional floor on every axis where it appears.)
+DEGRADATION_ORDER: Dict[str, Tuple[str, ...]] = {
+    "ingest": ("fused_paged", "fused", "sort", "scatter"),
+    "storage": ("paged", "dense"),
+    "commit": ("fused", "fanout"),
+    "transport": ("sparse", "raw"),
+}
+
+
+def incapability(
+    axis: str,
+    contender: str,
+    ctx: PathContext,
+    include_policy: bool = True,
+) -> Optional[Tuple[str, str]]:
+    """Walk one table row: the first failing edge as ``(edge_name,
+    reason)``, or None when the contender is capable.  This is the ONE
+    reason-string walk behind every ``*_incapability`` view —
+    ``include_policy=False`` is what the explicit-selection
+    ``crossover=False`` contract maps onto."""
+    for edge in CAPABILITY_TABLE[(axis, contender)]:
+        if edge.policy and not include_policy:
+            continue
+        reason = edge.check(ctx)
+        if reason is not None:
+            return edge.name, reason
+    return None
+
+
+# -- public incapability views (pre-r17 signatures, table-backed) ----- #
+
+
 def fused_ingest_incapability(
     num_metrics: int,
     batch_size: int | None = None,
     mesh: bool = False,
     acc_dtype: str = "int32",
     crossover: bool = True,
+    platform: str | None = None,
 ) -> str | None:
     """Why a configuration genuinely cannot (or should not) run the r13
     fused sample->scatter kernel, as a human-readable reason string — or
@@ -220,38 +560,91 @@ def fused_ingest_incapability(
     ``crossover=False`` skips the amortization checks (batch unknown /
     batch too small) — those are performance policy, not correctness,
     and an explicit selection is allowed to eat the preprocess cost.
+    ``platform``, when known, selects the calibrated per-platform batch
+    crossover (fused_min_batch_for)."""
+    ctx = PathContext(
+        num_metrics=num_metrics, batch_size=batch_size, mesh=mesh,
+        acc_dtype=acc_dtype, platform=platform,
+    )
+    hit = incapability("ingest", "fused", ctx, include_policy=crossover)
+    return None if hit is None else hit[1]
+
+
+def fused_paged_incapability(
+    num_metrics: int,
+    num_buckets: int | None = None,
+    batch_size: int | None = None,
+    mesh: bool = False,
+    transport: str = "auto",
+    platform: str | None = None,
+    crossover: bool = True,
+) -> str | None:
+    """Why a configuration cannot (or should not) take the r17
+    direct-to-paged fused ingest — the one-dispatch compress -> encode
+    -> page-translate -> pool-scatter kernel.  Same contract as its
+    siblings: auto degrades (to the host-fold translate + packed pool
+    commit) with the reason, an explicit ``ingest_path="fused"`` on a
+    paged store raises it; ``crossover=False`` skips the policy edges
+    (platform preference, batch amortization, threshold switch)."""
+    ctx = PathContext(
+        num_metrics=num_metrics, num_buckets=num_buckets,
+        batch_size=batch_size, mesh=mesh, transport=transport,
+        platform=platform,
+    )
+    hit = incapability("ingest", "fused_paged", ctx, include_policy=crossover)
+    return None if hit is None else hit[1]
+
+
+def paged_storage_incapability(
+    num_metrics: int,
+    num_buckets: int | None = None,
+    mesh: bool = False,
+    transport: str = "sparse",
+    crossover: bool = True,
+    fused_ok: bool = False,
+) -> str | None:
+    """Why a configuration genuinely cannot (or should not) run the r14
+    paged bucket backend, as a human-readable reason string — or None
+    when it can.  Same contract as ``fused_ingest_incapability``:
+    storage="auto" degrades silently on a reason, an EXPLICIT
+    ``storage="paged"`` surfaces the same string in its raise.
+
+    ``crossover=False`` skips the metric-cardinality check — that is
+    capacity policy, not correctness, and an explicit selection is
+    allowed to page a small deployment (the tests do).  ``fused_ok=True``
+    (the r17 direct-to-paged fused kernel is capable) relaxes the
+    transport edge: raw batches then ingest straight into the pool with
+    no host fold, so "raw" no longer disqualifies paged storage."""
+    ctx = PathContext(
+        num_metrics=num_metrics, num_buckets=num_buckets, mesh=mesh,
+        transport=transport, fused_ok=fused_ok,
+    )
+    hit = incapability("storage", "paged", ctx, include_policy=crossover)
+    return None if hit is None else hit[1]
+
+
+def mesh_commit_incapability(mesh, num_metrics=None) -> str | None:
+    """Why a sharded configuration genuinely cannot run the fused
+    commit under ``shard_map``, as a human-readable reason string — or
+    None when it can (including ``mesh=None``: single-device state is
+    always capable).  The checks mirror what the sharded program
+    actually requires:
+
+      * the mesh must carry the ("stream", "metric") commit layout —
+        the program psums cell deltas over the stream axis and keeps
+        every carry metric-row-sharded;
+      * ``num_metrics`` (when known) must split evenly over the metric
+        axis, or the carries cannot take their ``P(metric)`` row
+        sharding at all.
     """
-    if mesh:
-        return (
-            "mesh shape: the fused kernel does not run inside a "
-            "shard_map-embedded step (pallas_call under shard_map is not "
-            "hardware-validated; the sharded path keeps its dispatched "
-            "local fold)"
-        )
-    if num_metrics % FUSED_ROWS_TILE:
-        return (
-            f"mesh shape: num_metrics={num_metrics} does not divide by "
-            f"the fused kernel's {FUSED_ROWS_TILE}-row metric tile"
-        )
-    if acc_dtype != "int32":
-        return (
-            f"dtype: accumulator dtype {acc_dtype} is not int32 — the "
-            "fused kernel's per-tile f32 one-hot accumulation is "
-            "integer-exact only against the int32 dense layout"
-        )
-    if crossover and batch_size is None:
-        return (
-            "batch too small: batch size unknown, cannot prove the "
-            f"sort+layout preprocess amortizes (needs >= {FUSED_MIN_BATCH} "
-            "samples/batch)"
-        )
-    if crossover and batch_size is not None and batch_size < FUSED_MIN_BATCH:
-        return (
-            f"batch too small: {batch_size} samples/batch does not "
-            "amortize the fused kernel's sort+layout preprocess "
-            f"(measured crossover {FUSED_MIN_BATCH})"
-        )
-    return None
+    ctx = PathContext(
+        num_metrics=num_metrics or 0, mesh=mesh is not None, mesh_obj=mesh
+    )
+    hit = incapability("commit", "fused", ctx)
+    return None if hit is None else hit[1]
+
+
+# -- resolution ------------------------------------------------------- #
 
 
 def choose_ingest_path(
@@ -320,7 +713,7 @@ def resolve_ingest_path(
         # kernel the shape/batch would invalidate
         path = choose_ingest_path(num_metrics, num_buckets, platform)
         if path == "fused" and fused_ingest_incapability(
-            guard, batch_size=batch_size, mesh=mesh
+            guard, batch_size=batch_size, mesh=mesh, platform=platform
         ) is not None:
             # degrade to the pre-r13 high-cardinality winner, which then
             # takes its own shape validation below
@@ -382,7 +775,7 @@ def choose_transport(
 ) -> str:
     """Pick the host->device transport for transport="auto".
 
-    ``density`` is the measured unique-cells / samples ratio of a probe
+    ``density`` is the measured unique-cell / samples ratio of a probe
     flush (None before any probe has run).  The policy: start on "raw"
     (zero host fold cost, always correct), and switch to "sparse" once a
     probe shows the load is skewed enough that shipping packed triples
@@ -401,86 +794,6 @@ def choose_transport(
     return "raw"
 
 
-def mesh_commit_incapability(mesh, num_metrics=None) -> str | None:
-    """Why a sharded configuration genuinely cannot run the fused
-    commit under ``shard_map``, as a human-readable reason string — or
-    None when it can (including ``mesh=None``: single-device state is
-    always capable).  The checks mirror what the sharded program
-    actually requires:
-
-      * the mesh must carry the ("stream", "metric") commit layout —
-        the program psums cell deltas over the stream axis and keeps
-        every carry metric-row-sharded;
-      * ``num_metrics`` (when known) must split evenly over the metric
-        axis, or the carries cannot take their ``P(metric)`` row
-        sharding at all.
-    """
-    if mesh is None:
-        return None
-    from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
-
-    axes = tuple(getattr(mesh, "axis_names", ()))
-    if STREAM_AXIS not in axes or METRIC_AXIS not in axes:
-        return (
-            f"mesh axes {axes!r} are not the ('{STREAM_AXIS}', "
-            f"'{METRIC_AXIS}') commit layout"
-        )
-    n_metric = mesh.shape[METRIC_AXIS]
-    if num_metrics is not None and num_metrics % n_metric:
-        return (
-            f"num_metrics={num_metrics} rows don't shard evenly over "
-            f"the {n_metric}-way metric axis"
-        )
-    return None
-
-
-def paged_storage_incapability(
-    num_metrics: int,
-    num_buckets: int | None = None,
-    mesh: bool = False,
-    transport: str = "sparse",
-    crossover: bool = True,
-) -> str | None:
-    """Why a configuration genuinely cannot (or should not) run the r14
-    paged bucket backend, as a human-readable reason string — or None
-    when it can.  Same contract as ``fused_ingest_incapability``:
-    storage="auto" degrades silently on a reason, an EXPLICIT
-    ``storage="paged"`` surfaces the same string in its raise.
-
-    ``crossover=False`` skips the metric-cardinality check — that is
-    capacity policy, not correctness, and an explicit selection is
-    allowed to page a small deployment (the tests do).
-    """
-    if mesh:
-        return (
-            "mesh shape: paged storage does not run on a sharded mesh "
-            "(the page pool is a single-device arena; the page table's "
-            "slot ids are meaningless across shards — the sharded path "
-            "keeps its dense row-sharded accumulator)"
-        )
-    if transport not in ("sparse", "auto"):
-        return (
-            f"transport: paged storage commits through the packed "
-            f"[n,3] sparse-triple fold (transport='sparse'); "
-            f"transport={transport!r} ships whole batches with no host "
-            "fold, so there is no translate step to route cells through "
-            "the page table"
-        )
-    if num_buckets is not None and num_buckets < PAGE_SIZE:
-        return (
-            f"bucket axis: num_buckets={num_buckets} is smaller than "
-            f"one {PAGE_SIZE}-bucket page — the dense row is already "
-            "cheaper than any page table"
-        )
-    if crossover and num_metrics < PAGED_MIN_METRICS:
-        return (
-            f"below crossover: {num_metrics} metric rows — the dense "
-            f"accumulator fits HBM trivially below {PAGED_MIN_METRICS} "
-            "rows and its donated in-place commit wins (PAGED_STORE_r14)"
-        )
-    return None
-
-
 def resolve_storage_path(
     storage: str,
     num_metrics: int,
@@ -488,6 +801,7 @@ def resolve_storage_path(
     platform: str,
     mesh: bool = False,
     transport: str = "sparse",
+    fused_ok: bool = False,
 ) -> tuple[str, str | None]:
     """Resolve the accumulator storage backend: "dense" (the donated
     [M, B] tensor) or "paged" (page pool + page table + per-row codecs,
@@ -498,6 +812,10 @@ def resolve_storage_path(
 
     Returns ``(resolved, reason)`` — reason is None unless auto
     declined paged.
+
+    ``fused_ok=True`` marks a capable r17 direct-to-paged fused ingest:
+    the transport edge then admits "raw" (see
+    ``paged_storage_incapability``).
 
     Labeled metrics (ISSUE 16): ``num_metrics`` counts REGISTRY ROWS,
     and under the canonical label encoding every distinct label set of
@@ -513,7 +831,8 @@ def resolve_storage_path(
         if not PAGED_STORAGE:
             return "dense", "paged storage disabled by threshold table"
         reason = paged_storage_incapability(
-            num_metrics, num_buckets, mesh=mesh, transport=transport
+            num_metrics, num_buckets, mesh=mesh, transport=transport,
+            fused_ok=fused_ok,
         )
         if reason is not None:
             return "dense", reason
@@ -526,7 +845,7 @@ def resolve_storage_path(
     if storage == "paged":
         reason = paged_storage_incapability(
             num_metrics, num_buckets, mesh=mesh, transport=transport,
-            crossover=False,
+            crossover=False, fused_ok=fused_ok,
         )
         if reason is not None:
             raise ValueError(f"paged storage unavailable: {reason}")
@@ -574,6 +893,102 @@ def resolve_commit_path(
     return path
 
 
+class FullPath(NamedTuple):
+    """One resolved end-to-end dispatch: which wire the samples ride
+    (transport), which kernel consumes them (ingest), which layout
+    accumulates them (storage), and which program closes the interval
+    (commit) — plus every reason the walk declined a more-capable
+    contender, keyed "axis:contender"."""
+
+    transport: str
+    ingest: str
+    storage: str
+    commit: str
+    reasons: Dict[str, str]
+
+
+def resolve_full_path(
+    num_metrics: int,
+    num_buckets: int,
+    platform: str,
+    ingest: str = "auto",
+    storage: str = "auto",
+    transport: str = "auto",
+    commit: str = "auto",
+    batch_size: int | None = None,
+    mesh=None,
+    guard_metrics: int | None = None,
+    density: float | None = None,
+) -> FullPath:
+    """THE composed resolver (r17): one walk of the capability table's
+    degradation orders that answers all four axes together, because the
+    axes are NOT independent — paged storage without the fused kernel
+    pins the sparse transport (the translate step rides the host fold),
+    while a capable fused_paged contender inverts that (raw samples
+    ingest straight into the pool and the host fold disappears).  The
+    per-edge reasons of every declined contender come back in
+    ``reasons`` so callers (TPUAggregator's ``storage_reason`` /
+    ``fused_paged_reason``, the bench's path table) surface WHY, with
+    the same strings the explicit paths raise."""
+    reasons: Dict[str, str] = {}
+    mesh_flag = mesh is not None and mesh is not False
+    mesh_obj = None if isinstance(mesh, bool) or mesh is None else mesh
+
+    # 1. the fused_paged contender's capability gates BOTH the storage
+    #    transport edge and the ingest ladder's top rung
+    fp_reason = fused_paged_incapability(
+        num_metrics, num_buckets, batch_size=batch_size, mesh=mesh_flag,
+        transport=transport, platform=platform,
+        crossover=(ingest == "auto"),
+    )
+    fused_ok = fp_reason is None and ingest in ("auto", "fused")
+    if fp_reason is not None:
+        reasons["ingest:fused_paged"] = fp_reason
+
+    # 2. storage (may raise on explicit-invalid, same as before)
+    storage_res, s_reason = resolve_storage_path(
+        storage, num_metrics, num_buckets, platform, mesh=mesh_flag,
+        transport=transport, fused_ok=fused_ok,
+    )
+    if s_reason is not None:
+        reasons["storage:paged"] = s_reason
+
+    # 3. ingest + transport, jointly
+    if storage_res == "paged" and fused_ok:
+        if ingest == "fused" and fp_reason is not None:
+            raise ValueError(f"fused paged ingest unavailable: {fp_reason}")
+        ingest_res = "fused_paged"
+        transport_res = "raw"  # the batch IS the wire; no host fold
+    elif storage_res == "paged":
+        if ingest == "fused" and fp_reason is not None:
+            raise ValueError(f"fused paged ingest unavailable: {fp_reason}")
+        # pre-r17 paged route: host fold -> translate -> packed commit;
+        # no per-sample ingest kernel runs at all
+        ingest_res = "packed"
+        transport_res = "sparse"
+    else:
+        ingest_res = resolve_ingest_path(
+            ingest, num_metrics, num_buckets, platform,
+            guard_metrics=guard_metrics, batch_size=batch_size,
+            mesh=mesh_flag,
+        )
+        if transport == "auto":
+            transport_res = choose_transport(platform, density=density)
+        else:
+            transport_res = transport
+
+    # 4. commit
+    commit_reason = mesh_commit_incapability(mesh_obj, num_metrics)
+    if commit_reason is not None:
+        reasons["commit:fused"] = commit_reason
+    commit_res = resolve_commit_path(
+        commit, platform, mesh=mesh if mesh_obj is not None else mesh_flag,
+        num_metrics=num_metrics,
+    )
+    return FullPath(transport_res, ingest_res, storage_res, commit_res,
+                    reasons)
+
+
 def ingest_step_fn(path: str):
     """The pure per-batch accumulation function for a named path, with the
     uniform ``f(acc, ids, values, bucket_limit, precision) -> acc``
@@ -581,7 +996,10 @@ def ingest_step_fn(path: str):
     paths whose dense accumulator layout is interchangeable; pallas
     additionally requires acc shape [1, B]).  Used wherever a traced step
     needs the dispatched kernel inline (firehose generation loop, bench
-    interval loop) rather than the TPUAggregator's jitted wrappers."""
+    interval loop) rather than the TPUAggregator's jitted wrappers.
+    The r17 "fused_paged" contender is NOT here: its accumulator is the
+    page pool + LUT operands, a different contract
+    (ops/fused_ingest.fused_paged_ingest_batch)."""
     if path == "sort":
         from loghisto_tpu.ops.sort_ingest import sort_ingest_batch
 

@@ -42,6 +42,41 @@ re-exported as ``fused_ingest_reference`` — because that scatter
 composition IS the semantics the kernel must reproduce bit-for-bit
 (tests/test_fused_ingest.py pins the parity across denormals, negative
 values, inf/NaN sanitization, row-boundary ids, and empty batches).
+
+Direct-to-paged fusion (r17)
+----------------------------
+
+``fused_paged_ingest_batch`` extends the fusion all the way into the
+paged backend (ops/paged_store.py): through r16, paged mode paid a
+host fold (raw batch -> packed triples) plus a host page-table
+translate before its pool commit dispatch — and combining the r13
+kernel with paged storage would have materialized the dense [M, B]
+accumulator only to re-encode and recommit it.  Here the whole
+pipeline runs in ONE donated jitted program per batch:
+
+  1. (XLA preprocess, same program) compress every raw value with the
+     shared ``bucket_indices`` codec, gather the row's codec *encode*
+     LUT (``enc_luts[row_codec[row], dense]`` — the circllhist
+     log-linear / polytail layouts reduced to LUTs by
+     loghisto_tpu/paging.py), gather the device page-table mirror to a
+     flat pool cell (slot * page_size + offset), and fold duplicate
+     cells with one sort + segment-sum — all static [N] shapes, no
+     [M, B] tensor ever exists.  Invalid ids (and cells whose page the
+     host declined) park on the sentinel flat index, sort to the end,
+     and become the dropped filler cell; the reserved slot-0 zero page
+     stays the unmapped-read mask and is never written.
+  2. (Pallas kernel — the ONE pallas_call of the program) the folded
+     (slot, offset, count) cells take the sparse-ingest per-cell DMA
+     scatter with pool pages as the rows (``pallas_paged_scatter``):
+     serial grid, int32 adds — exact cross-tile accumulation by
+     construction.
+
+The host half (PagedStore.prepare_batch) stays off the dispatch path:
+codec assignment and page allocation for everything a batch touches
+happen in one vectorized pass on the transfer worker BEFORE the upload,
+so the page table never blocks the dispatch.  Bit-identity oracle: jnp
+encode + ``paged_scatter_batch`` over per-sample triples
+(tests/test_fused_paged.py pins it across all three codecs).
 """
 
 from __future__ import annotations
@@ -57,6 +92,7 @@ from loghisto_tpu.config import PRECISION
 from loghisto_tpu.ops.backend import default_interpret
 from loghisto_tpu.ops.ingest import bucket_indices
 from loghisto_tpu.ops.ingest import ingest_batch as fused_ingest_reference  # noqa: F401
+from loghisto_tpu.ops.paged_store import ZERO_SLOT, pallas_paged_scatter
 from loghisto_tpu.ops.pallas_kernels import LANES, SAMPLE_TILE
 
 # Metric rows per accumulator block resident in VMEM.  8 matches the
@@ -245,6 +281,122 @@ def make_fused_ingest_fn(
     def ingest(acc, ids, values):
         return fused_ingest_batch(
             acc, ids, values, bucket_limit, precision, interpret=interpret
+        )
+
+    return ingest
+
+
+# Sentinel flat pool cell for samples that must drop (invalid id, row
+# without a codec, page the host declined to map, zero-page hit).  One
+# past the largest index validate_pool_shape admits, so the scatter's
+# bounds guard discards it — the same "park past the end" idiom as
+# paged_scatter_batch's mode="drop" filler.
+_DROP_CELL = 2**31 - 2
+
+
+def fused_paged_ingest_batch(
+    pool: jnp.ndarray,
+    ids: jnp.ndarray,
+    values: jnp.ndarray,
+    row_codec: jnp.ndarray,
+    enc_luts: jnp.ndarray,
+    page_table: jnp.ndarray,
+    bucket_limit: int,
+    precision: int = PRECISION,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Direct-to-paged fused step: raw (ids, values) -> donated pool
+    [P, page_size] int32 in ONE Pallas dispatch.
+
+    The codec encode and page translate that paging.py performs on the
+    host for the packed-commit path run here as three gathers on static
+    [N] shapes; duplicate cells fold with one sort + segment-sum so the
+    scatter sees each touched cell once per batch (per-cell DMA cost
+    tracks UNIQUE cells, not samples).  Operands beyond the batch are
+    the PagedStore device mirrors (``PagedStore.device_luts``):
+
+      row_codec   int32 [M]        codec id per row (-1 = unassigned —
+                                   those samples drop; the host assigns
+                                   codecs in prepare_batch BEFORE the
+                                   dispatch, so a -1 here means the host
+                                   chose to spill the row)
+      enc_luts    int32 [C, B]     per-codec dense->storage encode LUTs
+      page_table  int32 [M, ppr]   pool slot per (row, storage page),
+                                   -1 = unmapped (drops)
+
+    Exactness: every count is an int32 add into the pool (the f32 path
+    exists only inside bucket_indices, identical to every other tier);
+    the segment fold is integer; ordering never matters.  Slot 0 (the
+    reserved zero page) is excluded by the valid mask here AND shifted
+    out of range by pallas_paged_scatter — double-guarded like the
+    translate step.
+    """
+    pages, page_size = pool.shape
+    if page_table.ndim != 2:
+        raise ValueError(
+            f"page_table must be [M, pages_per_row]; got {page_table.shape}"
+        )
+    if enc_luts.ndim != 2 or enc_luts.shape[1] != 2 * bucket_limit + 1:
+        raise ValueError(
+            f"enc_luts must be [codecs, {2 * bucket_limit + 1}]; got "
+            f"{tuple(enc_luts.shape)}"
+        )
+    n = ids.shape[0]
+    if n == 0:
+        return pool
+    num_metrics = page_table.shape[0]
+
+    # -- XLA preprocess: compress -> encode -> translate -> fold, all
+    #    static [N] shapes (no [M, B] array exists on this path) --
+    dense = bucket_indices(values.astype(jnp.float32), bucket_limit, precision)
+    valid = (ids >= 0) & (ids < num_metrics)
+    row = jnp.where(valid, ids, 0).astype(jnp.int32)
+    codec = row_codec[row]
+    valid &= codec >= 0
+    storage = enc_luts[jnp.maximum(codec, 0), dense]
+    page_idx = storage // page_size
+    offset = storage - page_idx * page_size
+    slot = page_table[row, page_idx]
+    valid &= slot > ZERO_SLOT
+    flat = jnp.where(
+        valid, slot * page_size + offset, jnp.int32(_DROP_CELL)
+    )
+
+    # fold duplicates: sort parks dropped samples at the end, then each
+    # run of equal cells collapses to (cell, run length) on its first
+    # position — everything else becomes a slot -1 filler triple
+    sorted_flat = jnp.sort(flat)
+    is_start = jnp.concatenate(
+        [jnp.ones(1, dtype=bool), sorted_flat[1:] != sorted_flat[:-1]]
+    )
+    seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    seg_counts = jnp.zeros(n, dtype=jnp.int32).at[seg].add(1)
+    keep = is_start & (sorted_flat != _DROP_CELL)
+    slots = jnp.where(keep, sorted_flat // page_size, jnp.int32(-1))
+    offs = jnp.where(keep, sorted_flat % page_size, 0)
+    counts = jnp.where(keep, seg_counts[seg], 0)
+    packed = jnp.stack([slots, offs, counts], axis=1).astype(jnp.int32)
+
+    # -- the ONE pallas_call of the program --
+    return pallas_paged_scatter(pool, packed, interpret=interpret)
+
+
+def make_fused_paged_ingest_fn(
+    bucket_limit: int,
+    precision: int = PRECISION,
+    interpret: bool | None = None,
+):
+    """Jitted, donated-pool direct-to-paged step: f(pool [P, page_size],
+    ids [N], values [N], row_codec [M], enc_luts [C, B],
+    page_table [M, ppr]) -> pool.  One executable per (pool shape, batch
+    length, table shape); the aggregator fixes the batch length to its
+    staging chunk and PagedStore re-makes the fn on table growth."""
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def ingest(pool, ids, values, row_codec, enc_luts, page_table):
+        return fused_paged_ingest_batch(
+            pool, ids, values, row_codec, enc_luts, page_table,
+            bucket_limit, precision, interpret=interpret,
         )
 
     return ingest

@@ -112,13 +112,19 @@ def paged_scatter_batch(pool: jnp.ndarray, packed: jnp.ndarray) -> jnp.ndarray:
     return flat.reshape(pages, page_size)
 
 
-def pallas_paged_scatter(pool: jnp.ndarray, packed: jnp.ndarray) -> jnp.ndarray:
+def pallas_paged_scatter(
+    pool: jnp.ndarray,
+    packed: jnp.ndarray,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
     """Pallas tier: same contract as paged_scatter_batch, executed as
     the sparse-ingest per-cell DMA round-trip with pool pages as the
     rows (one [1, page_size] VMEM scratch, serial grid => exact integer
     accumulation for duplicate cells)."""
     from loghisto_tpu.ops.sparse_ingest import TRIPLE_TILE, _pallas_kernel
 
+    if interpret is None:
+        interpret = default_interpret()
     if packed.ndim != 2 or packed.shape[1] != 3:
         raise ValueError(
             f"packed must be [n, 3] (slot, offset, count); got {packed.shape}"
@@ -162,7 +168,7 @@ def pallas_paged_scatter(pool: jnp.ndarray, packed: jnp.ndarray) -> jnp.ndarray:
             pltpu.SemaphoreType.DMA(()),
         ],
         input_output_aliases={3: 0},
-        interpret=default_interpret(),
+        interpret=interpret,
     )(ids, offs, weights, pool)
 
 

@@ -296,6 +296,18 @@ class PagedStore:
         self.released_pages = 0
         self.overflowed_cells = 0
         self.spilled_cells = 0
+        self.fused_dispatches = 0
+
+        # fused direct-to-paged ingest state: device mirrors of
+        # (row_codec, enc LUTs, page table), re-uploaded lazily only
+        # after a host mutation (codec assignment, page alloc/release,
+        # permutation, growth) — in the steady state where every page a
+        # workload touches is mapped, no mirror H2D happens at all
+        self._mirror = None
+        self._fused_fn = None
+        self._storage_buckets = np.array(
+            [c.storage_buckets for c in self._codecs], dtype=np.int64
+        )
 
         if config.overflow_row is not None:
             self._reserve_overflow_pages(config.overflow_row)
@@ -323,6 +335,8 @@ class PagedStore:
         for r in new_rows:
             mask = rows == r
             self.row_codec[r] = self._choose_codec(dense_idx[mask])
+        if len(new_rows):
+            self._mirror = None
 
     def set_row_codec(self, row: int, name: str) -> None:
         """Pin a row's codec explicitly (checkpoint restore, tests).
@@ -335,6 +349,7 @@ class PagedStore:
                     f"{self._codecs[self.row_codec[row]].name!r}"
                 )
         self.row_codec[row] = want
+        self._mirror = None
 
     # -- allocation ----------------------------------------------------- #
 
@@ -354,6 +369,7 @@ class PagedStore:
                     )
                 self.page_table[row, p] = self._free.pop()
                 self.allocated_pages += 1
+        self._mirror = None
 
     def _alloc(self, row: int, page_idx: int) -> int:
         """One page allocation; returns the slot or -1 when saturated."""
@@ -362,6 +378,7 @@ class PagedStore:
         slot = self._free.pop()
         self.page_table[row, page_idx] = slot
         self.allocated_pages += 1
+        self._mirror = None
         return slot
 
     @property
@@ -498,6 +515,140 @@ class PagedStore:
         pad = np.zeros((COMMIT_CHUNK, 3), dtype=np.int32)
         pad[:, 0] = -1
         self._pool = self._commit(self._pool, jnp.asarray(pad))
+
+    # -- fused direct-to-paged ingest (r17) ------------------------------ #
+
+    def device_luts(self):
+        """Device mirrors (row_codec int32 [M], enc_luts int32 [C, B],
+        page_table int32 [M, ppr]) for the fused ingest kernel, cached
+        until a host mutation dirties them (_mirror = None sites)."""
+        if self._mirror is None:
+            import jax.numpy as jnp
+
+            self._mirror = (
+                jnp.asarray(self.row_codec, dtype=jnp.int32),
+                jnp.asarray(self._enc),
+                jnp.asarray(self.page_table),
+            )
+        return self._mirror
+
+    def prepare_batch(
+        self, ids: np.ndarray, values: np.ndarray
+    ) -> Tuple[np.ndarray, int]:
+        """Bridge-thread half of the fused path: one vectorized pass
+        that assigns codecs and allocates every page the batch's rows
+        need BEFORE the upload, so the dispatch never consults the host
+        table.  Returns (ids_rewritten, spilled_sample_count):
+
+          * rows whose page cannot be mapped (pool saturated) rewrite to
+            the overflow row — the device then encodes them under the
+            overflow codec against its eagerly-reserved pages, exactly
+            like translate()'s redirect;
+          * with no overflow row, those samples fold into the exact host
+            spill here and their ids rewrite to -1 (the kernel's dropped
+            filler), so the count still lands somewhere accountable.
+
+        The host codec runs in f64 (compress_np_host) while the kernel
+        compresses in f32; a boundary value can therefore land one
+        dense bucket off on device.  Since every encode LUT is
+        monotonic, one dense bucket is at most one STORAGE bucket, so
+        mapping the +/-1 storage neighbors' pages too keeps the device
+        write covered whichever side of the boundary it rounds to.
+        """
+        from loghisto_tpu._native import compress_np_host
+
+        out = np.array(ids, dtype=np.int32, copy=True)
+        valid = (out >= 0) & (out < self.num_metrics)
+        if not valid.any():
+            return out, 0
+        rows = out[valid].astype(np.int64)
+        L = self.bucket_limit
+        dense_idx = (
+            np.clip(
+                compress_np_host(
+                    np.asarray(values, dtype=np.float64)[valid],
+                    self.precision,
+                ),
+                -L,
+                L,
+            ).astype(np.int64)
+            + L
+        )
+        self._assign_codecs(rows, dense_idx)
+        codec = self.row_codec[rows]
+        storage = self._enc[codec, dense_idx].astype(np.int64)
+        page = self.config.page_size
+        cap = self._storage_buckets[codec] - 1
+        cand_pages = np.concatenate([
+            storage // page,
+            np.maximum(storage - 1, 0) // page,
+            np.minimum(storage + 1, cap) // page,
+        ])
+        cand_rows = np.concatenate([rows, rows, rows])
+        missing = self.page_table[cand_rows, cand_pages] < 0
+        if missing.any():
+            pairs = np.unique(
+                np.stack(
+                    [cand_rows[missing], cand_pages[missing]], axis=1
+                ),
+                axis=0,
+            )
+            for r, p in pairs:
+                self._alloc(int(r), int(p))
+
+        spilled = 0
+        slots = self.page_table[rows, storage // page]
+        unmapped = slots < 0
+        if unmapped.any():
+            where = np.nonzero(valid)[0][unmapped]
+            ov = self.config.overflow_row
+            if ov is not None:
+                self.overflowed_cells += len(where)
+                out[where] = ov
+            else:
+                pairs, counts = np.unique(
+                    np.stack(
+                        [rows[unmapped], dense_idx[unmapped]], axis=1
+                    ),
+                    axis=0,
+                    return_counts=True,
+                )
+                self.spilled_cells += len(pairs)
+                self.spill_cells(pairs[:, 0], pairs[:, 1], counts)
+                out[where] = -1
+                spilled = len(where)
+        return out, spilled
+
+    def _fused_ingest_fn(self):
+        if self._fused_fn is None:
+            from loghisto_tpu.ops.fused_ingest import (
+                make_fused_paged_ingest_fn,
+            )
+
+            self._fused_fn = make_fused_paged_ingest_fn(
+                self.bucket_limit, self.precision
+            )
+        return self._fused_fn
+
+    def ingest_raw(self, ids_dev, values_dev) -> None:
+        """ONE-dispatch raw ingest into the donated pool.  The batch
+        must have gone through prepare_batch before upload; ids the
+        host rewrote to -1 drop on device."""
+        self._pool = self._fused_ingest_fn()(
+            self._pool, ids_dev, values_dev, *self.device_luts()
+        )
+        self.fused_dispatches += 1
+
+    def warmup_fused(self, batch_size: int) -> None:
+        """Pre-compile THE fused ingest executable at the staging chunk
+        shape (all-(-1) ids: numerically a no-op — every sample takes
+        the dropped filler cell)."""
+        import jax.numpy as jnp
+
+        ids = jnp.full(batch_size, -1, dtype=jnp.int32)
+        vals = jnp.zeros(batch_size, dtype=jnp.float32)
+        self.ingest_raw(ids, vals)
+        self.fused_dispatches -= 1  # warmup is not a real dispatch
 
     # -- failure / spill ------------------------------------------------- #
 
@@ -720,6 +871,7 @@ class PagedStore:
                     freed += 1
             self.row_codec[r] = -1
         self.released_pages += freed
+        self._mirror = None
         return freed
 
     def apply_permutation(self, perm: List[int], m_rows: int) -> None:
@@ -736,6 +888,7 @@ class PagedStore:
             new_codec[new_id] = self.row_codec[old_id]
         self.page_table = new_table
         self.row_codec = new_codec
+        self._mirror = None
         with self._lock:
             remap = {
                 old_id: new_id
@@ -763,6 +916,7 @@ class PagedStore:
             [self.row_codec, np.full(extra, -1, dtype=np.int8)]
         )
         self.num_metrics = new_m
+        self._mirror = None
 
     def max_cell(self) -> int:
         """Largest single pool count (spill-threshold headroom checks)."""
@@ -781,3 +935,4 @@ class PagedStore:
         for row, name in enumerate(names[: self.num_metrics]):
             if name is not None and self.row_codec[row] < 0:
                 self.row_codec[row] = self._codec_ids[name]
+        self._mirror = None

@@ -723,19 +723,38 @@ class TPUAggregator:
         # / SPARSE_DENSITY_CROSSOVER).  "preagg" stays an explicit
         # opt-in: its record-time fold trades producer-thread CPU for
         # flush latency, a workload property no flush-side probe sees.
-        # storage backend (r14): resolved BEFORE the transport rewrite
-        # below because paged storage pins the sparse transport (the
-        # page-table translate step rides the packed-triple fold).
-        from loghisto_tpu.ops.dispatch import resolve_storage_path
+        # storage backend (r14/r17): resolved BEFORE the transport
+        # rewrite below because the storage choice pins the transport —
+        # paged with the direct-to-paged fused kernel (r17) keeps RAW
+        # (compress/encode/translate all run on device), paged without
+        # it pins sparse (the page-table translate rides the host fold).
+        from loghisto_tpu.ops.dispatch import (
+            fused_paged_incapability,
+            resolve_storage_path,
+        )
 
+        backend = jax.default_backend()
+        self.fused_paged_reason = fused_paged_incapability(
+            num_metrics, config.num_buckets, batch_size=batch_size,
+            mesh=mesh is not None, transport=transport, platform=backend,
+            crossover=(ingest_path == "auto"),
+        )
+        fused_paged_ok = (
+            self.fused_paged_reason is None
+            and ingest_path in ("auto", "fused")
+        )
         self.storage, self.storage_reason = resolve_storage_path(
             storage, num_metrics, config.num_buckets,
-            jax.default_backend(), mesh=mesh is not None,
-            transport=transport,
+            backend, mesh=mesh is not None,
+            transport=transport, fused_ok=fused_paged_ok,
         )
         self.paged = None
+        self.fused_paged = self.storage == "paged" and fused_paged_ok
         if self.storage == "paged":
-            transport = "sparse"  # auto pins; raw/preagg raised above
+            # fused path ingests raw; host-fold fallback pins sparse
+            # (auto pins either way; incompatible explicit transports
+            # raised inside resolve_storage_path)
+            transport = "raw" if self.fused_paged else "sparse"
         self._transport_auto = transport == "auto"
         self.probe_density: Optional[float] = None
         if transport == "auto":
@@ -797,6 +816,14 @@ class TPUAggregator:
             # page table ARE the accumulator.  Every _acc touch below is
             # behind a `self.paged is not None` branch.
             self._acc = None
+            if self.fused_paged:
+                ingest_path = "fused_paged"
+            elif ingest_path == "fused":
+                raise ValueError(
+                    "ingest_path='fused' with paged storage needs the "
+                    "direct-to-paged fused kernel: "
+                    f"{self.fused_paged_reason}"
+                )
         else:
             self._acc = jnp.zeros(
                 (num_metrics, config.num_buckets), dtype=jnp.int32
@@ -863,6 +890,12 @@ class TPUAggregator:
             if reason is not None:
                 raise ValueError(f"ingest_path='fused': {reason}")
             self._ingest = self._make_dense_step_fn("fused")
+        elif ingest_path == "fused_paged":
+            # direct-to-paged fused kernel (r17): dispatches run through
+            # PagedStore.ingest_raw inside _dispatch_slot_locked — the
+            # donated pool is the accumulator, so there is no dense
+            # f(acc, ids, values) step fn to build here
+            self._ingest = None
         elif ingest_path == "multirow":
             if mesh is not None:
                 raise ValueError(
@@ -1456,11 +1489,16 @@ class TPUAggregator:
         self, ids: np.ndarray, values: np.ndarray, n: int
     ) -> bool:
         """transport="auto" density probe (runs once, on the worker, on
-        the first raw item large enough to be representative): measure
-        unique-cell density on a _PROBE_SAMPLES prefix with the host
-        codec, and switch to the sparse transport when the load is
-        skewed past the crossover.  Returns True when THIS item should
-        already take the fold path."""
+        the first raw item large enough to be representative): fold the
+        WHOLE item to unique (row, bucket) cells with the host codec and
+        switch to the sparse transport when the load is skewed past the
+        crossover.  The fold must see the full item, not a prefix —
+        PAGED_STORE_r14 measured the old 64Ki-prefix probe reading
+        density 0.92 on a 100k-row skew because a prefix shorter than
+        the interval cannot observe within-interval duplication (most
+        prefix samples land on distinct cells even when every cell
+        repeats hundreds of times across the batch).  Returns True when
+        THIS item should already take the fold path."""
         if not self._transport_auto or self.probe_density is not None:
             return False
         if n < _PROBE_SAMPLES:
@@ -1468,15 +1506,14 @@ class TPUAggregator:
         from loghisto_tpu import _native
         from loghisto_tpu.ops import dispatch as _dispatch
 
-        m = _PROBE_SAMPLES
         buckets = _native.compress_np_host(
-            values[:m], self.config.precision
+            values, self.config.precision
         ).astype(np.int64)
-        keep = ids[:m] >= 0
+        keep = ids >= 0
         kept = int(keep.sum())
         if not kept:
             return False
-        keys = (ids[:m][keep].astype(np.int64) << 16) | (
+        keys = (ids[keep].astype(np.int64) << 16) | (
             buckets[keep] + 32768
         )
         self.probe_density = len(np.unique(keys)) / kept
@@ -1555,11 +1592,19 @@ class TPUAggregator:
                         # injected device failure takes the organic
                         # recovery (cooldown + requeue remainder)
                         inj.check("agg.ingest")
-                    self._acc = self._ingest(
-                        self._acc,
-                        ids_dev[lo:lo + bs],
-                        values_dev[lo:lo + bs],
-                    )
+                    if self.paged is not None:
+                        # direct-to-paged (r17): ONE Pallas dispatch
+                        # straight into the donated pool (the batch was
+                        # page-prepared on the worker before staging)
+                        self.paged.ingest_raw(
+                            ids_dev[lo:lo + bs], values_dev[lo:lo + bs]
+                        )
+                    else:
+                        self._acc = self._ingest(
+                            self._acc,
+                            ids_dev[lo:lo + bs],
+                            values_dev[lo:lo + bs],
+                        )
                     self._device_down_until = 0.0
                     self._interval_ingested += min(bs, send - off)
                     # int32 overflow guarantee: the check must run per
@@ -1587,13 +1632,13 @@ class TPUAggregator:
         exact sample conservation: everything before the failing offset
         was applied, everything from it on is requeued from the host
         arrays (which also covers a staged-but-undispatched next slot)."""
-        if self.paged is not None:
+        if self.paged is not None and not self.fused_paged:
             # reached only through _process_fold's MemoryError fallback
-            # (paged pins transport="sparse").  There is no dense device
-            # loop to fall back to, and re-entering the fold would repeat
-            # the failed allocation — compress on the host and take the
-            # exact spill instead.  Rare by construction; correctness
-            # over throughput.
+            # (non-fused paged pins transport="sparse").  There is no
+            # dense device loop to fall back to, and re-entering the
+            # fold would repeat the failed allocation — compress on the
+            # host and take the exact spill instead.  Rare by
+            # construction; correctness over throughput.
             from loghisto_tpu._native import compress_np_host
 
             buckets = compress_np_host(
@@ -1609,6 +1654,18 @@ class TPUAggregator:
                 )
             self._xfer_samples_shipped += n
             return
+        if self.paged is not None:
+            # fused direct-to-paged (r17): assign codecs and map every
+            # page this batch touches in one vectorized host pass on
+            # THIS worker thread, BEFORE anything uploads — the
+            # staged/dispatched loop below never consults the host page
+            # table, so allocation can never block a dispatch.  ids come
+            # back rewritten (saturation -> overflow row or -1 + exact
+            # host spill), so a post-failure requeue of these arrays
+            # stays count-exact: spilled counts were applied here
+            # exactly once and their ids are already -1.
+            with self._dev_lock:
+                ids, _ = self.paged.prepare_batch(ids, values)
         bs = self.batch_size
         ring = self._staging_ring
         if ring is None or ring.slot_samples != 8 * bs:
@@ -1984,6 +2041,11 @@ class TPUAggregator:
         if self.paged is not None:
             with self._dev_lock:
                 self.paged.warmup()
+                if self.fused_paged:
+                    # one all-dropped compile at THE staging chunk shape
+                    # — every fused dispatch launches exactly batch_size
+                    # samples, so this covers all of them
+                    self.paged.warmup_fused(self.batch_size)
             return
         ids = np.full(_MERGE_CHUNK, -1, dtype=np.int32)
         zeros = np.zeros(_MERGE_CHUNK, dtype=np.int32)

@@ -1,0 +1,314 @@
+"""Regression pins for the r17 unified capability table (ops/dispatch.py).
+
+Three independently-grown contender ladders (fused ingest r13, paged
+storage r14, mesh commit) collapsed into ONE CAPABILITY_TABLE of named
+edges with a single degradation order.  These tests pin:
+
+  * every pre-r17 reason string, now produced through the shared
+    ``incapability`` walk — the refactor must not reword what operators
+    see in degrade logs and explicit-path raises;
+  * the r17 fused_paged contender's own edges (threshold switch,
+    transport, platform) and its COMPOSED walk order — each edge
+    declined in sequence until the ladder is exhausted;
+  * ``resolve_full_path``: the joint resolution where a capable
+    fused_paged contender flips the paged transport from sparse (host
+    fold + translate) to raw (one-dispatch direct ingest).
+
+No jax import: dispatch.py is deliberately importable without jax
+(analyze_capture.py depends on it), and so is this file.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from loghisto_tpu.ops import dispatch
+
+
+class _MeshStub:
+    """Just the surface mesh_commit_incapability inspects."""
+
+    def __init__(self, axis_names, shape):
+        self.axis_names = axis_names
+        self.shape = shape
+
+
+# ---------------------------------------------------------------------- #
+# the table itself: shape, edge ordering, policy flags
+# ---------------------------------------------------------------------- #
+
+
+def test_capability_table_rows_and_orders():
+    assert set(dispatch.CAPABILITY_TABLE) == {
+        ("ingest", "fused"),
+        ("storage", "paged"),
+        ("commit", "fused"),
+        ("ingest", "fused_paged"),
+    }
+    assert dispatch.DEGRADATION_ORDER["ingest"][0] == "fused_paged"
+    assert dispatch.DEGRADATION_ORDER["ingest"][-1] == "scatter"
+    assert dispatch.DEGRADATION_ORDER["storage"] == ("paged", "dense")
+    assert dispatch.DEGRADATION_ORDER["commit"] == ("fused", "fanout")
+
+
+def test_policy_edges_are_exactly_the_crossovers():
+    # crossover=False must skip exactly the perf-policy edges; pin which
+    # edges carry the flag so a new correctness check can't silently
+    # become operator-overridable (or vice versa)
+    policy = {
+        key: tuple(e.name for e in edges if e.policy)
+        for key, edges in dispatch.CAPABILITY_TABLE.items()
+    }
+    assert policy[("ingest", "fused")] == ("batch",)
+    assert policy[("storage", "paged")] == ("crossover",)
+    assert policy[("commit", "fused")] == ()
+    assert policy[("ingest", "fused_paged")] == (
+        "switch", "platform", "batch",
+    )
+
+
+def test_incapability_reports_first_failing_edge_name():
+    ctx = dispatch.PathContext(num_metrics=1 << 20, mesh=True)
+    hit = dispatch.incapability("ingest", "fused", ctx)
+    assert hit is not None and hit[0] == "mesh"
+    ctx = dispatch.PathContext(num_metrics=1 << 20, batch_size=1 << 20)
+    assert dispatch.incapability("ingest", "fused", ctx) is None
+
+
+# ---------------------------------------------------------------------- #
+# fused ingest (r13 strings through the table walk)
+# ---------------------------------------------------------------------- #
+
+
+def test_fused_ingest_reason_strings_survive_the_refactor():
+    reason = dispatch.fused_ingest_incapability(1 << 20, mesh=True)
+    assert reason is not None and "shard_map" in reason
+    reason = dispatch.fused_ingest_incapability(10_001, batch_size=1 << 20)
+    assert reason is not None
+    assert "does not divide" in reason and "8-row" in reason
+    reason = dispatch.fused_ingest_incapability(
+        10_000, batch_size=1 << 20, acc_dtype="float32"
+    )
+    assert reason is not None and "dtype" in reason and "int32" in reason
+    reason = dispatch.fused_ingest_incapability(10_000, batch_size=1 << 10)
+    assert reason is not None and "batch too small" in reason
+    reason = dispatch.fused_ingest_incapability(10_000)
+    assert reason is not None and "batch size unknown" in reason
+    assert dispatch.fused_ingest_incapability(
+        10_000, batch_size=1 << 20
+    ) is None
+    # crossover=False skips only the batch policy edge
+    assert dispatch.fused_ingest_incapability(
+        10_000, batch_size=1 << 10, crossover=False
+    ) is None
+    with pytest.raises(ValueError, match="does not divide"):
+        dispatch.resolve_ingest_path("fused", 10_001, 8193, "cpu")
+
+
+def test_fused_min_batch_platform_scoped(monkeypatch):
+    monkeypatch.setattr(
+        dispatch, "FUSED_MIN_BATCH_BY_PLATFORM", {"tpu": 1 << 12}
+    )
+    assert dispatch.fused_min_batch_for("tpu") == 1 << 12
+    # unmeasured platform / unknown platform -> baked fallback
+    assert dispatch.fused_min_batch_for("cpu") == dispatch.FUSED_MIN_BATCH
+    assert dispatch.fused_min_batch_for(None) == dispatch.FUSED_MIN_BATCH
+    # the batch edge consults the running platform's entry
+    assert dispatch.fused_ingest_incapability(
+        10_000, batch_size=1 << 12, platform="tpu"
+    ) is None
+    reason = dispatch.fused_ingest_incapability(
+        10_000, batch_size=1 << 12, platform="cpu"
+    )
+    assert reason is not None and "batch too small" in reason
+
+
+def test_fused_min_batch_rejects_bool_entries(monkeypatch):
+    monkeypatch.setattr(
+        dispatch, "FUSED_MIN_BATCH_BY_PLATFORM", {"tpu": True}
+    )
+    assert dispatch.fused_min_batch_for("tpu") == dispatch.FUSED_MIN_BATCH
+
+
+# ---------------------------------------------------------------------- #
+# paged storage (r14 strings + the r17 fused_ok transport relaxation)
+# ---------------------------------------------------------------------- #
+
+
+def test_paged_storage_fused_ok_admits_raw_transport():
+    big = 1 << 20
+    # without a capable fused kernel, raw transport disqualifies paged
+    reason = dispatch.paged_storage_incapability(big, transport="raw")
+    assert reason is not None and "transport" in reason
+    # a capable fused_paged contender relaxes exactly that edge
+    assert dispatch.paged_storage_incapability(
+        big, transport="raw", fused_ok=True
+    ) is None
+    # ...but not the others: preagg still has no route into the pool
+    reason = dispatch.paged_storage_incapability(
+        big, transport="preagg", fused_ok=True
+    )
+    assert reason is not None and "transport" in reason
+    reason = dispatch.paged_storage_incapability(
+        big, transport="raw", fused_ok=True, mesh=True
+    )
+    assert reason is not None and "mesh" in reason
+
+
+def test_resolve_storage_path_fused_ok_flows_through():
+    big = 1 << 20
+    storage, reason = dispatch.resolve_storage_path(
+        "auto", big, 8193, "cpu", transport="raw"
+    )
+    assert storage == "dense" and "transport" in reason
+    storage, reason = dispatch.resolve_storage_path(
+        "auto", big, 8193, "cpu", transport="raw", fused_ok=True
+    )
+    assert storage == "paged" and reason is None
+    with pytest.raises(ValueError, match="transport"):
+        dispatch.resolve_storage_path(
+            "paged", big, 8193, "cpu", transport="raw"
+        )
+    assert dispatch.resolve_storage_path(
+        "paged", 8, 8193, "cpu", transport="raw", fused_ok=True
+    ) == ("paged", None)
+
+
+# ---------------------------------------------------------------------- #
+# fused_paged (r17): every edge declined in ladder order
+# ---------------------------------------------------------------------- #
+
+_CAPABLE = dict(
+    num_metrics=1 << 20,
+    num_buckets=8193,
+    batch_size=1 << 20,
+    transport="raw",
+    platform="tpu",
+)
+
+
+def test_fused_paged_capable_configuration_has_no_reason():
+    assert dispatch.fused_paged_incapability(**_CAPABLE) is None
+
+
+def test_fused_paged_declined_edge_by_edge(monkeypatch):
+    # walk the ladder in its declared order, tripping one edge at a time
+    # threshold switch (policy)
+    monkeypatch.setattr(dispatch, "FUSED_PAGED", False)
+    reason = dispatch.fused_paged_incapability(**_CAPABLE)
+    assert reason is not None and "disabled" in reason
+    assert dispatch.THRESHOLDS_SOURCE in reason
+    # crossover=False overrides the switch: it is policy, not correctness
+    assert dispatch.fused_paged_incapability(
+        **_CAPABLE, crossover=False
+    ) is None
+    monkeypatch.setattr(dispatch, "FUSED_PAGED", True)
+    # mesh (shared with the fused-ingest row)
+    reason = dispatch.fused_paged_incapability(
+        **{**_CAPABLE, "mesh": True}
+    )
+    assert reason is not None and "shard_map" in reason
+    # bucket axis (shared with the paged-storage row)
+    reason = dispatch.fused_paged_incapability(
+        **{**_CAPABLE, "num_buckets": dispatch.PAGE_SIZE - 1}
+    )
+    assert reason is not None and "bucket axis" in reason
+    # transport: the fused kernel eats RAW samples; a host-folded wire
+    # leaves it nothing to fuse
+    reason = dispatch.fused_paged_incapability(
+        **{**_CAPABLE, "transport": "sparse"}
+    )
+    assert reason is not None and "RAW" in reason
+    reason = dispatch.fused_paged_incapability(
+        **{**_CAPABLE, "transport": "preagg"}
+    )
+    assert reason is not None and "RAW" in reason
+    # platform (policy): auto only picks it on TPU
+    reason = dispatch.fused_paged_incapability(
+        **{**_CAPABLE, "platform": "cpu"}
+    )
+    assert reason is not None and "platform" in reason
+    assert dispatch.fused_paged_incapability(
+        **{**_CAPABLE, "platform": "cpu"}, crossover=False
+    ) is None
+    # batch (policy, platform-scoped like the r13 edge)
+    reason = dispatch.fused_paged_incapability(
+        **{**_CAPABLE, "batch_size": 1 << 10}
+    )
+    assert reason is not None and "batch too small" in reason
+    reason = dispatch.fused_paged_incapability(
+        **{**_CAPABLE, "batch_size": None}
+    )
+    assert reason is not None and "batch size unknown" in reason
+
+
+def test_fused_paged_does_not_inherit_rows_tile_or_dtype():
+    # the paged kernel is per-sample gather + per-cell DMA: no ROWS_TILE
+    # accumulator blocks, pool int32 by construction — an odd row count
+    # that disqualifies the r13 dense kernel must NOT disqualify this one
+    odd = dict(_CAPABLE, num_metrics=(1 << 20) + 1)
+    assert dispatch.fused_paged_incapability(**odd) is None
+    assert dispatch.fused_ingest_incapability(
+        (1 << 20) + 1, batch_size=1 << 20
+    ) is not None
+
+
+# ---------------------------------------------------------------------- #
+# resolve_full_path: the joint walk
+# ---------------------------------------------------------------------- #
+
+
+def test_full_path_tpu_paged_takes_one_dispatch_route():
+    fp = dispatch.resolve_full_path(
+        1 << 20, 8193, "tpu", batch_size=1 << 20
+    )
+    assert fp.ingest == "fused_paged"
+    assert fp.storage == "paged"
+    assert fp.transport == "raw"
+    assert "ingest:fused_paged" not in fp.reasons
+
+
+def test_full_path_cpu_paged_keeps_pre_r17_route_with_reason():
+    fp = dispatch.resolve_full_path(
+        1 << 20, 8193, "cpu", batch_size=1 << 20
+    )
+    assert fp.ingest == "packed"
+    assert fp.storage == "paged"
+    assert fp.transport == "sparse"
+    assert "platform" in fp.reasons["ingest:fused_paged"]
+
+
+def test_full_path_dense_below_crossover_with_reason():
+    fp = dispatch.resolve_full_path(16, 8193, "cpu", batch_size=1 << 20)
+    assert fp.storage == "dense"
+    assert "below crossover" in fp.reasons["storage:paged"]
+    assert fp.ingest == "scatter"
+
+
+def test_full_path_explicit_fused_on_incapable_paged_raises():
+    with pytest.raises(ValueError, match="fused paged ingest unavailable"):
+        dispatch.resolve_full_path(
+            1 << 20, 8193, "tpu", ingest="fused", transport="sparse",
+            storage="paged", batch_size=1 << 20,
+        )
+
+
+def test_full_path_mesh_declines_everything_with_reasons():
+    mesh = _MeshStub(("stream", "metric"), {"stream": 2, "metric": 3})
+    fp = dispatch.resolve_full_path(
+        1 << 20, 8193, "tpu", batch_size=1 << 20, mesh=mesh
+    )
+    assert fp.storage == "dense"
+    assert fp.commit == "fanout"
+    assert "shard_map" in fp.reasons["ingest:fused_paged"]
+    assert "mesh" in fp.reasons["storage:paged"]
+    assert "3-way" in fp.reasons["commit:fused"]
+
+
+def test_full_path_commit_stays_fused_on_capable_mesh():
+    mesh = _MeshStub(("stream", "metric"), {"stream": 2, "metric": 4})
+    fp = dispatch.resolve_full_path(
+        1 << 16, 8193, "tpu", batch_size=1 << 20, mesh=mesh
+    )
+    assert fp.commit == "fused"
+    assert "commit:fused" not in fp.reasons

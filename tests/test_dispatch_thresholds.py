@@ -20,6 +20,8 @@ def restore_dispatch_globals():
         dispatch.SPARSE_KERNEL,
         dispatch.FUSED_INGEST,
         dispatch.FUSED_MIN_BATCH,
+        dispatch.FUSED_MIN_BATCH_BY_PLATFORM,
+        dispatch.FUSED_PAGED,
         dispatch.PAGED_STORAGE,
         dispatch.PAGED_MIN_METRICS,
         dispatch.THRESHOLDS_FILE,
@@ -34,6 +36,8 @@ def restore_dispatch_globals():
         dispatch.SPARSE_KERNEL,
         dispatch.FUSED_INGEST,
         dispatch.FUSED_MIN_BATCH,
+        dispatch.FUSED_MIN_BATCH_BY_PLATFORM,
+        dispatch.FUSED_PAGED,
         dispatch.PAGED_STORAGE,
         dispatch.PAGED_MIN_METRICS,
         dispatch.THRESHOLDS_FILE,
@@ -398,3 +402,87 @@ def test_paged_threshold_overrides(tmp_path, restore_dispatch_globals):
     dispatch._load_thresholds()
     assert dispatch.PAGED_STORAGE is True
     assert dispatch.PAGED_MIN_METRICS == 1 << 10
+
+
+# -- FUSED_MIN_BATCH calibration (r17 satellite) ------------------------ #
+
+def test_fused_min_batch_platform_override(tmp_path, restore_dispatch_globals):
+    """The per-platform crossover table rides the same committed-JSON
+    machinery; the running platform's entry wins, everything else falls
+    back to the baked FUSED_MIN_BATCH."""
+    path = tmp_path / "dispatch_thresholds.json"
+    path.write_text(json.dumps({
+        "source": "bench.py crossover sweep (tpu)",
+        "fused_min_batch_by_platform": {"tpu": 1 << 15, "cpu": True},
+    }))
+    dispatch.THRESHOLDS_FILE = str(path)
+    dispatch._load_thresholds()
+    # bool entries are filtered at load (bool is an int subclass)
+    assert dispatch.FUSED_MIN_BATCH_BY_PLATFORM == {"tpu": 1 << 15}
+    assert dispatch.fused_min_batch_for("tpu") == 1 << 15
+    assert dispatch.fused_min_batch_for("cpu") == dispatch.FUSED_MIN_BATCH
+    assert dispatch.fused_min_batch_for(None) == dispatch.FUSED_MIN_BATCH
+
+
+def test_fused_paged_kill_switch(tmp_path, restore_dispatch_globals):
+    """fused_paged rides the threshold table like its siblings: the
+    switch is policy (auto declines with the table's source named), and
+    explicit selection overrides it via crossover=False."""
+    path = tmp_path / "dispatch_thresholds.json"
+    path.write_text(json.dumps({
+        "source": "TPU_CAPTURE_test", "fused_paged": False,
+    }))
+    dispatch.THRESHOLDS_FILE = str(path)
+    dispatch._load_thresholds()
+    assert dispatch.FUSED_PAGED is False
+    reason = dispatch.fused_paged_incapability(
+        1 << 20, num_buckets=8193, batch_size=1 << 20, transport="raw",
+        platform="tpu",
+    )
+    assert reason is not None and "TPU_CAPTURE_test" in reason
+    assert dispatch.fused_paged_incapability(
+        1 << 20, num_buckets=8193, transport="raw", crossover=False,
+    ) is None
+
+
+def test_derive_and_write_fused_min_batch(tmp_path, restore_dispatch_globals):
+    """bench.py's calibration stage: a measured crossover becomes a
+    platform-scoped entry merged into the thresholds file (other keys
+    preserved); a sweep with no crossover writes nothing."""
+    from benchmarks.fused_ingest_bench import (
+        derive_fused_min_batch, write_fused_min_batch,
+    )
+
+    assert derive_fused_min_batch(
+        {"platform": "cpu", "measured_crossover_batch": None}
+    ) is None
+    assert derive_fused_min_batch(
+        {"platform": "", "measured_crossover_batch": 1 << 16}
+    ) is None
+    update = derive_fused_min_batch(
+        {"platform": "tpu", "measured_crossover_batch": 1 << 16}
+    )
+    assert update == {"fused_min_batch_by_platform": {"tpu": 1 << 16}}
+
+    path = tmp_path / "dispatch_thresholds.json"
+    path.write_text(json.dumps({
+        "source": "TPU_CAPTURE_test", "sort_min_metrics": 512,
+        "fused_min_batch_by_platform": {"cpu": 1 << 18},
+    }))
+    write_fused_min_batch(update, path=str(path), source="bench sweep")
+    table = json.loads(path.read_text())
+    # merged, not clobbered: the capture's other entries survive
+    assert table["sort_min_metrics"] == 512
+    assert table["fused_min_batch_by_platform"] == {
+        "cpu": 1 << 18, "tpu": 1 << 16,
+    }
+    assert table["source"] == "bench sweep"
+    dispatch.THRESHOLDS_FILE = str(path)
+    dispatch._load_thresholds()
+    assert dispatch.fused_min_batch_for("tpu") == 1 << 16
+    assert dispatch.fused_min_batch_for("cpu") == 1 << 18
+    # creating the file from nothing works too
+    fresh = tmp_path / "fresh.json"
+    write_fused_min_batch(update, path=str(fresh))
+    assert json.loads(fresh.read_text())[
+        "fused_min_batch_by_platform"] == {"tpu": 1 << 16}

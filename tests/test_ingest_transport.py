@@ -276,6 +276,30 @@ def test_auto_probe_stays_raw_on_dense_load():
     agg.close()
 
 
+def test_auto_probe_folds_duplicates_across_the_whole_item():
+    """Regression (r17 satellite): the probe must fold unique cells over
+    the FULL item, not a prefix.  This load's first 64Ki samples are all
+    distinct cells (a prefix probe reads density ~1.0 and stays raw —
+    the PAGED_STORE_r14 misread), but the block repeats 4x across the
+    item, so the true density is ~0.25 and auto must switch sparse."""
+    base_n = 1 << 16
+    base_ids = np.arange(base_n, dtype=np.int32) % 4096
+    base_values = np.geomspace(1.0, 1e12, base_n).astype(np.float32)
+    ids = np.tile(base_ids, 4)
+    values = np.tile(base_values, 4)
+    agg = TPUAggregator(
+        num_metrics=4096, config=CFG, transport="auto",
+        batch_size=len(ids),
+    )
+    agg.record_batch(ids, values)
+    agg.flush(force=True)
+    assert agg.probe_density is not None
+    assert agg.probe_density <= 0.3  # a prefix probe would read ~1.0
+    assert agg.transport == "sparse"
+    assert int(_drained_acc(agg).sum()) == len(ids)
+    agg.close()
+
+
 def test_pallas_sparse_tier_matches_jnp_tier():
     """The Pallas per-cell-DMA tier (interpret mode off-TPU) is
     bit-identical to the XLA scatter tier, including dropped ids and
