@@ -13,12 +13,13 @@ than compiled Mosaic — so CPU numbers calibrate the PIPELINE (dispatch
 budget, overlap pct, route shape), not the kernel; the per-chip
 roofline fraction only means something from a --tpu capture.
 
-The mesh table is a RESOLUTION table, not a scaling sweep: the page
-pool is a single-device arena, so every sharded shape resolves off the
-fused_paged route with the capability table's own reason string —
-published so the declined shapes are visible next to the single-device
-fraction instead of silently absent (MESH_SCALE_r13 has the sharded
-dense scaling story).
+The mesh table is a RESOLUTION table, not a scaling sweep: since r18
+the page pool shards across the ("stream","metric") mesh, so every
+listed shape resolves ONTO the fused_paged route (the r17 rows showed
+them declining off it; MESH_PAGED_r18.json has the sharded paged
+scaling story, MESH_SCALE_r13 the sharded dense one).  A shape that
+still declines — wrong axes, indivisible metric count — publishes the
+capability table's own reason string instead of a fraction.
 
 Usage: python benchmarks/fused_paged_bench.py [--metrics 4096]
        [--bucket-limit 512] [--batch 65536] [--reps 3] [--out FILE]
@@ -144,6 +145,10 @@ def run(num_metrics: int = 4_096, bucket_limit: int = 512,
                   "two-stage fold+translate+commit, samples/sec/chip",
         "platform": platform,
         "pallas_interpret": platform != "tpu",
+        # artifact-level honesty flag: interpret-mode (non-TPU) numbers
+        # characterize the pipeline shape, never the kernel — suspect
+        # regardless of whether the roofline guard also tripped
+        "suspect": bool(suspect or platform != "tpu"),
         "num_metrics": num_metrics,
         "num_buckets": 2 * bucket_limit + 1,
         "batch": batch,
@@ -197,7 +202,12 @@ def run_mesh_table(num_metrics: int = 1 << 16, bucket_limit: int = 4_096,
             "commit": fp.commit,
         }
         if fp.ingest == "fused_paged":
-            row["roofline_fraction"] = single_roofline_fraction
+            # the measured fraction belongs to the shape it was measured
+            # on; sharded shapes resolve the route (r18) but their
+            # throughput story lives in MESH_PAGED_r18.json
+            row["roofline_fraction"] = (
+                single_roofline_fraction if shape == "single" else None
+            )
         else:
             row["roofline_fraction"] = None
             row["declined"] = fp.reasons.get(
@@ -253,8 +263,13 @@ def run_interval_budget(num_metrics: int = 4_096, bucket_limit: int = 512,
             if hi > lo:
                 hidden_ns += hi - lo
     overlap_pct = 100.0 * hidden_ns / max(upload_ns, 1)
+    import jax
+
+    platform = jax.devices()[0].platform
     return {
         "metric": "paged-path interval budget + staging-ring overlap",
+        "platform": platform,
+        "suspect": platform != "tpu",
         "num_metrics": num_metrics,
         "batch": batch,
         "samples_shipped": shipped,

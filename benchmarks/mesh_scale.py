@@ -97,6 +97,9 @@ def run(num_metrics: int = 10_000, bucket_limit: int = 4_096,
 
     result = {
         "platform": platform,
+        # virtual CPU "devices" time-slice one core: absolute rates are
+        # not hardware numbers, only the mesh/single ratios are signal
+        "suspect": platform != "tpu",
         "n_devices": len(devs),
         "num_metrics": num_metrics,
         "num_buckets": cfg.num_buckets,
@@ -357,6 +360,9 @@ def run_commit(num_metrics: int = 1024, bucket_limit: int = 512,
     result = {
         "metric": "mesh-sharded fused commit vs fan-out, per mesh shape",
         "platform": platform,
+        # artifact-level flag mirroring the per-shape roofline guard:
+        # on virtual CPU devices every absolute rate is suspect
+        "suspect": platform != "tpu",
         "n_devices": n,
         "num_metrics": num_metrics,
         "num_buckets": cfg.num_buckets,

@@ -62,12 +62,21 @@ from loghisto_tpu.obs.spans import NULL_RECORDER, LatencyHistogram
 from loghisto_tpu.ops.commit import (
     COMMIT_CHUNK,
     CellStagingRing,
+    PagedTripleRing,
     make_fused_commit_fn,
     make_fused_commit_snapshot_fn,
+    make_paged_fused_commit_fn,
+    make_paged_fused_commit_snapshot_fn,
     make_sharded_fused_commit_fn,
     make_sharded_fused_commit_snapshot_fn,
+    make_sharded_paged_fused_commit_fn,
+    make_sharded_paged_fused_commit_snapshot_fn,
 )
-from loghisto_tpu.parallel.mesh import STREAM_AXIS, cell_sharding
+from loghisto_tpu.parallel.mesh import (
+    STREAM_AXIS,
+    cell_sharding,
+    triple_sharding,
+)
 from loghisto_tpu.window.snapshot import AccSnapshot
 from loghisto_tpu.window.store import trailing_mask
 
@@ -78,14 +87,14 @@ def commit_incompatibility(aggregator, wheel) -> Optional[str]:
     """Why this (aggregator, wheel) pair cannot share one fused commit
     program, or None when it can.  The fused program scatters ONE cell
     array into both carries, so the pair must agree on row ids (shared
-    registry) and bucket geometry (bucket_limit/precision)."""
-    if getattr(aggregator, "paged", None) is not None:
-        return (
-            "paged storage: the fused commit program scatters into the "
-            "dense [M, B] accumulator carry, which a paged aggregator "
-            "does not keep (its pool + page table ARE the accumulator); "
-            "the fan-out commit merges through the paged triple path"
-        )
+    registry) and bucket geometry (bucket_limit/precision).
+
+    r18: paged aggregators no longer refuse — the paged fused-commit
+    family (``ops.commit.make_paged_fused_commit_fn``) carries the pool
+    in the accumulator's place and scatters the interval's
+    host-translated triples into it in the same dispatch as the tier
+    rings; only the anomaly pairing (dense [M, B] interval-histogram
+    carry) stays dense-only, checked in the constructor."""
     if aggregator.registry is not wheel.registry:
         return "aggregator and wheel use different registries"
     if aggregator.config.bucket_limit != wheel.config.bucket_limit:
@@ -145,8 +154,18 @@ class IntervalCommitter:
         self.anomaly = anomaly
         track = lifecycle is not None
         track_b = anomaly is not None
+        self.paged = getattr(aggregator, "paged", None)
+        if anomaly is not None and self.paged is not None:
+            raise ValueError(
+                "drift engine requires the dense accumulator: the "
+                "interval-histogram and EWMA baseline-bank carries are "
+                "dense [M, B] tensors, which paged storage exists to "
+                "avoid keeping"
+            )
         self.mesh = getattr(aggregator, "mesh", None)
         staging_sharding = None
+        trip_sharding = None
+        tiers_n = len(wheel._tiers)
         if self.mesh is not None:
             # sharded fused path: identical operand protocol, but the
             # program runs under shard_map — staged cells arrive
@@ -159,28 +178,57 @@ class IntervalCommitter:
                     f"stream axis ({n_stream}): staged cell chunks always "
                     "pad to the full width, which must split evenly"
                 )
-            self._fused = make_sharded_fused_commit_fn(
-                self.mesh, len(wheel._tiers), track, track_b
-            )
-            self._fused_snap = make_sharded_fused_commit_snapshot_fn(
-                self.mesh, len(wheel._tiers), wheel.config.bucket_limit,
-                wheel.config.precision, wheel.merge_path,
-                track_activity=track, track_baseline=track_b,
-            )
+            if self.paged is not None:
+                self._fused = make_sharded_paged_fused_commit_fn(
+                    self.mesh, self.paged.shard_pages, tiers_n, track
+                )
+                self._fused_snap = make_sharded_paged_fused_commit_snapshot_fn(
+                    self.mesh, self.paged.shard_pages, tiers_n,
+                    wheel.config.bucket_limit, wheel.config.precision,
+                    wheel.merge_path, track_activity=track,
+                )
+                trip_sharding = triple_sharding(self.mesh)
+            else:
+                self._fused = make_sharded_fused_commit_fn(
+                    self.mesh, tiers_n, track, track_b
+                )
+                self._fused_snap = make_sharded_fused_commit_snapshot_fn(
+                    self.mesh, tiers_n, wheel.config.bucket_limit,
+                    wheel.config.precision, wheel.merge_path,
+                    track_activity=track, track_baseline=track_b,
+                )
             staging_sharding = cell_sharding(self.mesh)
+        elif self.paged is not None:
+            # paged pair (r18): the pool is the donated accumulator
+            # carry; each chunk's cells also translate to (slot, offset,
+            # count) triples on the host (under _dev_lock, so the page
+            # table can allocate) and ride the same dispatch
+            self._fused = make_paged_fused_commit_fn(tiers_n, track)
+            self._fused_snap = make_paged_fused_commit_snapshot_fn(
+                tiers_n, wheel.config.bucket_limit,
+                wheel.config.precision, wheel.merge_path,
+                track_activity=track,
+            )
         else:
-            self._fused = make_fused_commit_fn(len(wheel._tiers), track,
-                                               track_b)
+            self._fused = make_fused_commit_fn(tiers_n, track, track_b)
             # final-chunk variant: same fold + the query engine's snapshot
             # emission (per-tier window CDFs + the acc CDF) in ONE dispatch
             self._fused_snap = make_fused_commit_snapshot_fn(
-                len(wheel._tiers), wheel.config.bucket_limit,
+                tiers_n, wheel.config.bucket_limit,
                 wheel.config.precision, wheel.merge_path,
                 track_activity=track, track_baseline=track_b,
             )
         self._staging = CellStagingRing(depth=staging_depth,
                                         width=self.chunk,
                                         sharding=staging_sharding)
+        self._triples = (
+            PagedTripleRing(depth=staging_depth, width=self.chunk,
+                            sharding=trip_sharding)
+            if self.paged is not None else None
+        )
+        # the one chunk whose translate ran but whose dispatch hasn't
+        # succeeded yet — the failure handler's double-count guard
+        self._trip_inflight = None
 
         # self-metrics (ISSUE 2): per-interval dispatch/H2D accounting.
         # The latency store IS one of the system's own log-bucketed
@@ -451,6 +499,8 @@ class IntervalCommitter:
         applied = 0
         reset_tiers = ()
         payloads = acc_payload = None
+        paged = self.paged
+        bl = wheel.config.bucket_limit
         try:
             rec = self.obs_recorder
             inj = self.fault_injector
@@ -467,14 +517,35 @@ class IntervalCommitter:
                         idx[off:off + take],
                         w32[off:off + take],
                     )
+                    if paged is not None:
+                        # host translate against the page table (both
+                        # locks held — allocation is safe), then stage
+                        # the triples through their own overlap ring.
+                        # Cells translate can't place (arena saturated,
+                        # no overflow row) land in the exact host spill
+                        # INSIDE translate; the in-flight record keeps
+                        # the failure handler from re-spilling them.
+                        pk = np.empty((take, 3), dtype=np.int32)
+                        pk[:, 0] = ids[off:off + take]
+                        pk[:, 1] = np.clip(
+                            cells[1][off:off + take], -bl, bl
+                        )
+                        pk[:, 2] = w32[off:off + take]
+                        trip, _, _ = paged.translate(pk)
+                        self._trip_inflight = (trip, take)
+                        dev_trip = self._triples.stage(trip)
                 chunk_keeps = keeps if dispatches == 0 else ones
                 final = emit and off + take >= n
                 # operand ordering per make_fused_commit_fn /
-                # make_fused_commit_snapshot_fn: carries first (acc,
-                # rings, [la], [ihist], [banks]), then cells, then the
-                # traced scalars ([epoch], [masks], [ifirst, bank,
-                # decay, min_count])
-                args = [agg._acc, tuple(t.ring for t in tiers)]
+                # make_fused_commit_snapshot_fn (and their paged twins):
+                # carries first (acc-or-pool, rings, [la], [ihist],
+                # [banks]), then cells, [then triples], then the traced
+                # scalars ([epoch], [masks], [ifirst, bank, decay,
+                # min_count])
+                args = [
+                    paged._pool if paged is not None else agg._acc,
+                    tuple(t.ring for t in tiers),
+                ]
                 if lc is not None:
                     args.append(la)
                 if an is not None:
@@ -482,6 +553,8 @@ class IntervalCommitter:
                     if final:
                         args.append(banks)
                 args += [slots, chunk_keeps, dev_ids, dev_idx, dev_w]
+                if paged is not None:
+                    args.append(dev_trip)
                 if lc is not None:
                     args.append(epoch)
                 if final:
@@ -497,7 +570,10 @@ class IntervalCommitter:
                     out = iter(
                         (self._fused_snap if final else self._fused)(*args)
                     )
-                agg._acc = next(out)
+                if paged is not None:
+                    paged._pool = next(out)
+                else:
+                    agg._acc = next(out)
                 for t, r in zip(tiers, next(out)):
                     t.ring = r
                 if lc is not None:
@@ -510,9 +586,13 @@ class IntervalCommitter:
                     an.store_carry_locked(ihist, banks)
                 if final:
                     payloads = next(out)
-                    acc_payload = next(out)
+                    # the paged snapshot variant emits no acc payload —
+                    # pool counts live behind per-row codecs, served by
+                    # the paged query engine instead
+                    acc_payload = next(out) if paged is None else None
                 dispatches += 1
                 applied = off + take
+                self._trip_inflight = None
                 agg._device_down_until = 0.0
                 agg._interval_ingested += int(
                     w64[off:off + take].sum(dtype=np.int64)
@@ -523,7 +603,9 @@ class IntervalCommitter:
                 # instead of it leaking into whoever touches the carries
                 # next (a device failure here takes the normal recovery)
                 with rec.span("commit.device_sync"):
-                    jax.block_until_ready(agg._acc)
+                    jax.block_until_ready(
+                        paged._pool if paged is not None else agg._acc
+                    )
             if self.breaker is not None:
                 # closes a half-open breaker after a successful trial;
                 # failures are recorded in ONE place (the aggregator's
@@ -548,12 +630,13 @@ class IntervalCommitter:
                                                 payloads[ti])
                     for ti in range(len(tiers))
                 ))
-                agg.stats_snapshot = AccSnapshot(
-                    epoch=wheel.intervals_pushed,
-                    cdf=acc_payload["cdf"],
-                    counts=acc_payload["counts"],
-                    sums=acc_payload["sums"],
-                )
+                if acc_payload is not None:
+                    agg.stats_snapshot = AccSnapshot(
+                        epoch=wheel.intervals_pushed,
+                        cdf=acc_payload["cdf"],
+                        counts=acc_payload["counts"],
+                        sums=acc_payload["sums"],
+                    )
         return dispatches
 
     def _on_fused_failure_locked(self, cells, applied: int):
@@ -604,9 +687,19 @@ class IntervalCommitter:
                 "retention history was reset", len(reset),
             )
         ids, bidx64, w64 = cells
-        if applied < len(ids):
+        start = applied
+        trip_inflight, self._trip_inflight = self._trip_inflight, None
+        if self.paged is not None and trip_inflight is not None:
+            # the failed chunk's translate already ran: its host-spill
+            # portion was applied there, so only its DEVICE portion (the
+            # translated triples) re-lands, via the page-table inverse —
+            # spilling the chunk's cells would double-count
+            trip, take_failed = trip_inflight
+            self.paged.spill_triples(trip)
+            start = applied + take_failed
+        if start < len(ids):
             agg._spill_add_cells_locked(
-                ids[applied:], bidx64[applied:], w64[applied:]
+                ids[start:], bidx64[start:], w64[start:]
             )
         return tuple(reset)
 
@@ -627,7 +720,10 @@ class IntervalCommitter:
             dev_ids, dev_idx, dev_w = self._staging.stage(
                 empty, empty, empty
             )
-            args = [agg._acc, tuple(t.ring for t in tiers)]
+            args = [
+                self.paged._pool if self.paged is not None else agg._acc,
+                tuple(t.ring for t in tiers),
+            ]
             if lc is not None:
                 args.append(la)
             if an is not None:
@@ -635,6 +731,12 @@ class IntervalCommitter:
                 if final:
                     args.append(banks)
             args += [slots, keeps, dev_ids, dev_idx, dev_w]
+            if self.paged is not None:
+                # all-pad triple chunk (slot -1 drops): warms the paged
+                # program at THE fixed staging width
+                args.append(
+                    self._triples.stage(np.empty((0, 3), dtype=np.int32))
+                )
             if lc is not None:
                 args.append(epoch)
             if final:
@@ -648,7 +750,10 @@ class IntervalCommitter:
                     args += [an.bank_for(None), an.decay32,
                              an.min_count32]
             out = iter(fn(*args))
-            agg._acc = next(out)
+            if self.paged is not None:
+                self.paged._pool = next(out)
+            else:
+                agg._acc = next(out)
             for t, r in zip(tiers, next(out)):
                 t.ring = r
             if lc is not None:

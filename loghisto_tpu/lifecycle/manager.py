@@ -71,27 +71,26 @@ class LifecycleManager:
                 "lifecycle needs a retention wheel: activity tracking and"
                 " eviction ride the fused interval commit"
             )
-        if getattr(aggregator, "paged", None) is not None:
-            raise ValueError(
-                "lifecycle manager is dense-only: its fold/compact device"
-                " programs thread the dense [M, B] accumulator as a"
-                " donated carry, which a paged aggregator does not keep."
-                " Paged survivor repack composes at the PagedStore API"
-                " instead (release_rows / apply_permutation /"
-                " fold_rows_into return pages to the free pool)"
-            )
+        # r18: paged aggregators are first-class.  The device programs
+        # run in their with_acc=False form (rings + activity only) and
+        # the pool folds/repacks through the PagedStore API — eviction
+        # via fold_rows_into/drop_rows (count-exact host translate +
+        # pool commit), compaction via apply_permutation (a host
+        # page-table row permutation with zero device data movement).
+        self._paged = getattr(aggregator, "paged", None) is not None
         self.aggregator = aggregator
         self.wheel = wheel
         self.config = config
         self.metric_system = metric_system
         num_tiers = len(wheel._tiers)
-        self._fold = make_fold_evict_fn(num_tiers)
+        self._fold = make_fold_evict_fn(num_tiers, with_acc=not self._paged)
         platform = jax.default_backend()
         self._compact = make_compact_fn(
             num_tiers,
             resolve_compact_path(
                 config.compact_path, platform, aggregator.mesh is not None
             ),
+            with_acc=not self._paged,
         )
         self._touch = make_touch_fn()
 
@@ -262,19 +261,49 @@ class LifecycleManager:
         with agg._dev_lock:
             la = self.ensure_capacity_locked(agg.num_metrics)
             with wheel._lock:
-                acc, rings, la, vcounts = self._fold(
-                    agg._acc,
-                    tuple(t.ring for t in wheel._tiers),
-                    la,
-                    vpad,
-                    tpad,
-                    np.int32(self.epoch),
-                )
-                agg._acc = acc
+                moved_total = 0
+                if self._paged:
+                    # pool fold first (host translate + pool commit —
+                    # count-exact, returns the moved totals the dense
+                    # path reads off vcounts), grouped by overflow
+                    # target; shed targets (registry exhausted) drop
+                    # their pool pages outright — the host lifetime
+                    # folds below still preserve the totals
+                    by_target: Dict[int, List[int]] = {}
+                    shed: List[int] = []
+                    for mid, _, omid, _ in pairs:
+                        if omid >= 0:
+                            by_target.setdefault(omid, []).append(mid)
+                        else:
+                            shed.append(mid)
+                    for omid, vlist in by_target.items():
+                        moved_total += agg.paged.fold_rows_into(
+                            vlist, omid
+                        )
+                    if shed:
+                        agg.paged.drop_rows(shed)
+                    rings, la = self._fold(
+                        tuple(t.ring for t in wheel._tiers),
+                        la,
+                        vpad,
+                        tpad,
+                        np.int32(self.epoch),
+                    )
+                    vcounts = np.zeros(len(vids), dtype=np.int64)
+                else:
+                    acc, rings, la, vcounts = self._fold(
+                        agg._acc,
+                        tuple(t.ring for t in wheel._tiers),
+                        la,
+                        vpad,
+                        tpad,
+                        np.int32(self.epoch),
+                    )
+                    agg._acc = acc
+                    vcounts = np.asarray(vcounts)[: len(vids)]
                 for t, r in zip(wheel._tiers, rings):
                     t.ring = r
                 self._la = la
-                vcounts = np.asarray(vcounts)[: len(vids)]
                 if self.anomaly is not None:
                     # zero the victims' drift baselines in the same
                     # critical section: the freed slots' next tenants
@@ -322,7 +351,9 @@ class LifecycleManager:
         with self._metrics_lock:
             self.evictions += 1
             self.evicted_series += len(pairs)
-            self.overflowed_samples += int(vcounts.sum())
+            self.overflowed_samples += (
+                moved_total if self._paged else int(vcounts.sum())
+            )
         return [p[1] for p in pairs]
 
     # -- compaction ------------------------------------------------------- #
@@ -356,14 +387,37 @@ class LifecycleManager:
             la = self.ensure_capacity_locked(m_rows)
             with wheel._lock:
                 try:
-                    acc, rings, la = self._compact(
-                        agg._acc,
-                        tuple(t.ring for t in wheel._tiers),
-                        la,
-                        perm,
-                        np.int32(self.epoch),
-                    )
-                    jax.block_until_ready(acc)
+                    if self._paged:
+                        # pool repack is a host page-table row
+                        # permutation (zero device traffic); the
+                        # DROP_ID pads become the -1 holes PagedStore
+                        # expects.  Done after the registry commit
+                        # point, before the ring repack, so a ring
+                        # dispatch failure leaves registry + pool
+                        # consistently permuted.
+                        agg.paged.apply_permutation(
+                            [
+                                int(p) if 0 <= p < m_rows else -1
+                                for p in perm
+                            ],
+                            m_rows,
+                        )
+                        rings, la = self._compact(
+                            tuple(t.ring for t in wheel._tiers),
+                            la,
+                            perm,
+                            np.int32(self.epoch),
+                        )
+                        jax.block_until_ready(la)
+                    else:
+                        acc, rings, la = self._compact(
+                            agg._acc,
+                            tuple(t.ring for t in wheel._tiers),
+                            la,
+                            perm,
+                            np.int32(self.epoch),
+                        )
+                        jax.block_until_ready(acc)
                 except Exception:
                     logger.exception(
                         "compaction dispatch failed; recovering device "
@@ -375,7 +429,8 @@ class LifecycleManager:
                         self.anomaly.on_device_failure_locked()
                     wheel.lifecycle_invalidated_locked()
                     return False
-                agg._acc = acc
+                if not self._paged:
+                    agg._acc = acc
                 for t, r in zip(wheel._tiers, rings):
                     t.ring = r
                 self._la = la

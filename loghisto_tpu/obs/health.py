@@ -50,6 +50,11 @@ Invariants evaluated (each yields a machine-readable reason dict
     VM pause, or an injected ``clock_step``); per-emitter lag stays
     correct (monotonic-only) but wall-aligned trace merges and
     wall-stamped logs from that emitter are suspect (ISSUE 12).
+  * ``pool_saturation``      — a paged aggregator's fullest per-shard
+    page arena is at ≥ ``pool_saturation_fraction`` of its capacity;
+    the next page allocation in that shard spills to the host fold
+    (ISSUE 18).  Per-shard, not pod-wide: one hot metric shard
+    saturates alone while the mesh average still looks roomy.
 
 ``no_commit`` makes the report STALLED; every other reason makes it
 DEGRADED; otherwise OK.  Event-shaped invariants (fan-outs, evictions)
@@ -121,6 +126,7 @@ class HealthWatchdog:
         federation=None,
         federation_starvation_intervals: float = 3.0,
         federation_skew_tolerance_s: float = 1.0,
+        pool_saturation_fraction: float = 0.9,
     ):
         self._committer = committer
         self._agg = aggregator
@@ -137,6 +143,7 @@ class HealthWatchdog:
             federation_starvation_intervals
         )
         self.federation_skew_tolerance_s = float(federation_skew_tolerance_s)
+        self.pool_saturation_fraction = float(pool_saturation_fraction)
         self.interval = float(interval)
         self.stall_intervals = float(stall_intervals)
         self.backpressure_fraction = float(backpressure_fraction)
@@ -391,6 +398,29 @@ class HealthWatchdog:
                         "value": skew_s,
                     })
 
+        paged = getattr(agg, "paged", None)
+        if paged is not None:
+            # live state, not a latch: saturation persists until evict/
+            # compact/grow returns pages to the hot shard's free list.
+            # pool_saturation() is the MAX per-shard occupancy fraction
+            # — the spill decision is shard-local, so the pod-wide
+            # average hides the shard that is actually about to spill
+            sat = float(paged.pool_saturation())
+            if sat >= self.pool_saturation_fraction:
+                occ = paged.shard_occupancy()
+                hot = max(range(len(occ)), key=occ.__getitem__)
+                reasons.append({
+                    "code": "pool_saturation",
+                    "detail": (
+                        f"page-pool shard {hot} is {sat:.1%} full "
+                        f"(>= {self.pool_saturation_fraction:g} of its "
+                        f"{paged.shard_pages - 1}-page arena); its next "
+                        "page allocation spills to the host fold — "
+                        "evict, compact, or grow"
+                    ),
+                    "value": sat,
+                })
+
         down_until = float(getattr(agg, "_device_down_until", 0.0) or 0.0)
         if down_until > now:
             reasons.append({
@@ -438,7 +468,7 @@ class HealthWatchdog:
                      "thread_restarted", "breaker_open",
                      "recovery_in_progress", "emitter_starvation",
                      "fed_decode_errors", "fleet_freshness_stall",
-                     "emitter_clock_skew"):
+                     "emitter_clock_skew", "pool_saturation"):
             ms.register_gauge_func(
                 f"health.{code}",
                 lambda c=code: float(c in self.report().reason_codes()),

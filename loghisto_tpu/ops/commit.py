@@ -44,6 +44,7 @@ from jax.sharding import PartitionSpec as P
 
 from loghisto_tpu.config import PRECISION
 from loghisto_tpu.ops.ingest import sanitize_ids
+from loghisto_tpu.ops.paged_store import paged_scatter_batch
 from loghisto_tpu.ops.stats import dense_cdf
 from loghisto_tpu.ops.window import window_snapshot
 from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS, shard_map
@@ -508,6 +509,288 @@ def make_sharded_fused_commit_snapshot_fn(
     )
 
 
+@functools.lru_cache(maxsize=None)
+def make_paged_fused_commit_fn(num_tiers: int, track_activity: bool = False):
+    """The fused commit program for a PAGED aggregator (r18): the pool
+    replaces the dense accumulator carry, and the interval's cells ride
+    the dispatch twice — as dense ``(ids, idx, weights)`` for every
+    tier's open-slot scatter (tier rings stay dense), and as
+    host-translated ``(slot, offset, count)`` triples for the pool
+    scatter (``paged_scatter_batch``; translation against the page
+    table is a host decision, exactly as in ``PagedStore.commit``).
+
+    Returns ``commit(pool, rings, [last_active], slots, keeps, ids,
+    idx, weights, triples, [epoch]) -> (pool, rings, [last_active])``
+    with the same donation, drop-sentinel, and traced-scalar contracts
+    as ``make_fused_commit_fn`` — one dispatch still pays the
+    aggregator fold, every tier, and the activity stamp."""
+    donate = tuple(range(2 + int(track_activity)))
+
+    @functools.partial(jax.jit, donate_argnums=donate)
+    def commit(*args):
+        it = iter(args)
+        pool = next(it)
+        rings = next(it)
+        last_active = next(it) if track_activity else None
+        slots = next(it)
+        keeps = next(it)
+        ids = next(it)
+        idx = next(it)
+        weights = next(it)
+        triples = next(it)
+        epoch = next(it) if track_activity else None
+
+        pool = paged_scatter_batch(pool, triples)
+        new_rings = []
+        for t in range(num_tiers):
+            ring = rings[t]
+            ring = ring.at[slots[t]].multiply(keeps[t], mode="drop")
+            ring = ring.at[slots[t], ids, idx].add(weights, mode="drop")
+            new_rings.append(ring)
+        out = [pool, tuple(new_rings)]
+        if track_activity:
+            out.append(last_active.at[ids].max(epoch, mode="drop"))
+        return tuple(out)
+
+    return commit
+
+
+@functools.lru_cache(maxsize=None)
+def make_paged_fused_commit_snapshot_fn(
+    num_tiers: int,
+    bucket_limit: int,
+    precision: int = PRECISION,
+    merge_path: str = "jnp",
+    track_activity: bool = False,
+):
+    """Final-chunk variant of ``make_paged_fused_commit_fn``: the same
+    fold plus every tier's window-snapshot emission.  Unlike the dense
+    variant there is NO acc payload output — the pool's counts live
+    behind per-row codecs, so accumulator stats are served by the paged
+    query/stats engine (``PagedStore.query``), not a dense CDF ridden
+    on the commit.  Ordering: ``commit(pool, rings, [last_active],
+    slots, keeps, ids, idx, weights, triples, [epoch], masks) ->
+    (pool, rings, [last_active], tier_payloads)``."""
+    donate = tuple(range(2 + int(track_activity)))
+
+    @functools.partial(jax.jit, donate_argnums=donate)
+    def commit(*args):
+        it = iter(args)
+        pool = next(it)
+        rings = next(it)
+        last_active = next(it) if track_activity else None
+        slots = next(it)
+        keeps = next(it)
+        ids = next(it)
+        idx = next(it)
+        weights = next(it)
+        triples = next(it)
+        epoch = next(it) if track_activity else None
+        masks = next(it)
+
+        pool = paged_scatter_batch(pool, triples)
+        new_rings = []
+        payloads = []
+        for t in range(num_tiers):
+            ring = rings[t]
+            ring = ring.at[slots[t]].multiply(keeps[t], mode="drop")
+            ring = ring.at[slots[t], ids, idx].add(weights, mode="drop")
+            new_rings.append(ring)
+            payloads.append(
+                window_snapshot(ring, masks[t], bucket_limit, precision,
+                                merge_path)
+            )
+        out = [pool, tuple(new_rings)]
+        if track_activity:
+            out.append(last_active.at[ids].max(epoch, mode="drop"))
+        out.append(tuple(payloads))
+        return tuple(out)
+
+    return commit
+
+
+def _paged_shard_local_deltas(
+    pool, rings, last_active, ids, idx, weights, triples, shard_pages,
+    track_activity,
+):
+    """Shard-local body shared by the sharded PAGED commit factories.
+    The staged triples carry GLOBAL pool slots; re-basing by ``shard *
+    shard_pages`` puts this shard's own arena at [1, shard_pages) (its
+    local zero page at 0) and every other shard's triples out of range,
+    so ``paged_scatter_batch``'s validity mask implements ownership for
+    free.  Ring/activity deltas re-use the dense sharded idiom; ONE
+    ``psum`` over the stream axis merges every part."""
+    shard = jax.lax.axis_index(METRIC_AXIS)
+    local = jnp.stack(
+        [triples[:, 0] - shard * shard_pages, triples[:, 1], triples[:, 2]],
+        axis=1,
+    )
+    parts = {"pool": paged_scatter_batch(jnp.zeros_like(pool), local)}
+    for rows in sorted({r.shape[1] for r in rings}):
+        rids = sanitize_ids(ids - shard * rows)
+        parts[f"ring{rows}"] = (
+            jnp.zeros((rows, rings[0].shape[2]), rings[0].dtype)
+            .at[rids, idx].add(weights, mode="drop")
+        )
+    if track_activity:
+        la_rows = last_active.shape[0]
+        lids = sanitize_ids(ids - shard * la_rows)
+        parts["touched"] = (
+            jnp.zeros((la_rows,), jnp.int32).at[lids].max(1, mode="drop")
+        )
+    return jax.lax.psum(parts, STREAM_AXIS)
+
+
+def _sharded_paged_commit_specs(track_activity):
+    """Donated-carry prefix specs for the sharded paged factories:
+    (pool arenas over metric, tier ring rows over metric, [activity
+    rows over metric])."""
+    specs = [P(METRIC_AXIS, None), P(None, METRIC_AXIS, None)]
+    if track_activity:
+        specs.append(P(METRIC_AXIS))
+    return specs
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_paged_fused_commit_fn(
+    mesh, shard_pages: int, num_tiers: int, track_activity: bool = False
+):
+    """``make_paged_fused_commit_fn`` under the ("stream", "metric")
+    mesh: identical operand ordering and results (int32 scatter-adds
+    and the single stream psum are order-independent, so the committed
+    pool is bit-identical to the single-device paged fused path).  The
+    pool carry splits per metric-shard arena (``P(METRIC_AXIS, None)``,
+    each shard's zero page at its arena base), staged cells and triples
+    arrive stream-sharded, and everything downstream of the one psum is
+    shard-local — one collective, one dispatch per chunk."""
+    donate = tuple(range(2 + int(track_activity)))
+
+    def commit(*args):
+        it = iter(args)
+        pool = next(it)
+        rings = next(it)
+        last_active = next(it) if track_activity else None
+        slots = next(it)
+        keeps = next(it)
+        ids = next(it)
+        idx = next(it)
+        weights = next(it)
+        triples = next(it)
+        epoch = next(it) if track_activity else None
+
+        parts = _paged_shard_local_deltas(
+            pool, rings, last_active, ids, idx, weights, triples,
+            shard_pages, track_activity,
+        )
+        pool = pool + parts["pool"]
+        new_rings = []
+        for t in range(num_tiers):
+            ring = rings[t]
+            rd = parts[f"ring{ring.shape[1]}"]
+            ring = ring.at[slots[t]].multiply(keeps[t], mode="drop")
+            ring = ring.at[slots[t]].add(rd, mode="drop")
+            new_rings.append(ring)
+        out = [pool, tuple(new_rings)]
+        if track_activity:
+            out.append(jnp.where(parts["touched"] > 0,
+                                 jnp.maximum(last_active, epoch),
+                                 last_active))
+        return tuple(out)
+
+    carry_specs = _sharded_paged_commit_specs(track_activity)
+    in_specs = tuple(carry_specs) + (
+        P(), P(), P(STREAM_AXIS), P(STREAM_AXIS), P(STREAM_AXIS),
+        P(STREAM_AXIS, None),
+    )
+    if track_activity:
+        in_specs += (P(),)      # epoch
+    return jax.jit(
+        shard_map(
+            commit, mesh=mesh,
+            in_specs=in_specs, out_specs=tuple(carry_specs),
+        ),
+        donate_argnums=donate,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_paged_fused_commit_snapshot_fn(
+    mesh,
+    shard_pages: int,
+    num_tiers: int,
+    bucket_limit: int,
+    precision: int = PRECISION,
+    merge_path: str = "jnp",
+    track_activity: bool = False,
+):
+    """``make_paged_fused_commit_snapshot_fn`` under the mesh: the
+    sharded paged fold plus shard-local snapshot emission, payload
+    outputs metric-row-sharded like the dense sharded variant."""
+    donate = tuple(range(2 + int(track_activity)))
+
+    def commit(*args):
+        it = iter(args)
+        pool = next(it)
+        rings = next(it)
+        last_active = next(it) if track_activity else None
+        slots = next(it)
+        keeps = next(it)
+        ids = next(it)
+        idx = next(it)
+        weights = next(it)
+        triples = next(it)
+        epoch = next(it) if track_activity else None
+        masks = next(it)
+
+        parts = _paged_shard_local_deltas(
+            pool, rings, last_active, ids, idx, weights, triples,
+            shard_pages, track_activity,
+        )
+        pool = pool + parts["pool"]
+        new_rings = []
+        payloads = []
+        for t in range(num_tiers):
+            ring = rings[t]
+            rd = parts[f"ring{ring.shape[1]}"]
+            ring = ring.at[slots[t]].multiply(keeps[t], mode="drop")
+            ring = ring.at[slots[t]].add(rd, mode="drop")
+            new_rings.append(ring)
+            payloads.append(
+                window_snapshot(ring, masks[t], bucket_limit, precision,
+                                merge_path)
+            )
+        out = [pool, tuple(new_rings)]
+        if track_activity:
+            out.append(jnp.where(parts["touched"] > 0,
+                                 jnp.maximum(last_active, epoch),
+                                 last_active))
+        out.append(tuple(payloads))
+        return tuple(out)
+
+    carry_specs = _sharded_paged_commit_specs(track_activity)
+    in_specs = tuple(carry_specs) + (
+        P(), P(), P(STREAM_AXIS), P(STREAM_AXIS), P(STREAM_AXIS),
+        P(STREAM_AXIS, None),
+    )
+    if track_activity:
+        in_specs += (P(),)      # epoch
+    in_specs += (P(),)          # masks (prefix broadcast over the tuple)
+    tier_payload_spec = {
+        "cdf": P(None, METRIC_AXIS, None),
+        "counts": P(None, METRIC_AXIS),
+        "sums": P(None, METRIC_AXIS),
+    }
+    out_specs = tuple(carry_specs) + (
+        tuple(dict(tier_payload_spec) for _ in range(num_tiers)),
+    )
+    return jax.jit(
+        shard_map(
+            commit, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        ),
+        donate_argnums=donate,
+    )
+
+
 class CellStagingRing:
     """Depth-D double-buffered H2D staging for interval cell arrays.
 
@@ -572,4 +855,54 @@ class CellStagingRing:
         )
         self.uploads += 1
         self.bytes_uploaded += 3 * self.width * 4
+        return dev
+
+
+class PagedTripleRing:
+    """``CellStagingRing``'s twin for the paged committer's translated
+    ``(slot, offset, count)`` triples: same depth/overlap contract,
+    same fixed width (the commit chunk, so one executable serves every
+    interval), pad sentinel slot -1 (``paged_scatter_batch`` drops it).
+    Under a mesh the upload splits over the stream axis
+    (``triple_sharding``), matching the sharded paged commit's
+    ``P(STREAM_AXIS, None)`` operand spec."""
+
+    def __init__(self, depth: int = 2, width: int = COMMIT_CHUNK,
+                 sharding=None):
+        if depth < 2:
+            raise ValueError("staging ring depth must be >= 2 (the "
+                             "overlap contract needs one slot of slack)")
+        self.depth = depth
+        self.width = width
+        self.sharding = sharding
+        self._slots = [
+            np.empty((width, 3), dtype=np.int32) for _ in range(depth)
+        ]
+        self._next = 0
+        self.uploads = 0
+        self.bytes_uploaded = 0
+
+    def stage(self, triples: np.ndarray):
+        """Pad one translated triple chunk (len <= width) into the next
+        host slot and start its async upload; returns the device array."""
+        n = len(triples)
+        if n > self.width:
+            raise ValueError(f"chunk of {n} triples exceeds staging "
+                             f"width {self.width}")
+        buf = self._slots[self._next]
+        self._next = (self._next + 1) % self.depth
+        buf[:n] = triples
+        buf[n:, 0] = -1
+        buf[n:, 1] = 0
+        buf[n:, 2] = 0
+        if self.sharding is not None:
+            # collective-free across real jax.distributed processes
+            # (every process stages the identical translated chunk)
+            from loghisto_tpu.parallel.multihost import global_put
+
+            dev = global_put(buf, self.sharding)
+        else:
+            dev = jax.device_put(buf)
+        self.uploads += 1
+        self.bytes_uploaded += buf.nbytes
         return dev

@@ -165,6 +165,12 @@ PAGED_MIN_METRICS = 1 << 16
 # without importing jax (this module must stay importable without jax).
 PAGE_SIZE = 256
 
+# Fixed paged-commit launch width; mirrored from
+# ops/paged_store.COMMIT_CHUNK without importing jax.  The mesh edges
+# below check the stream axis divides it (the sharded paged commit
+# splits the padded triple wire over the stream axis).
+PAGED_COMMIT_CHUNK = 1 << 14
+
 # Capture-derived threshold table (VERDICT r2 item 7): refreshing the
 # dispatch policy after a hardware capture is a committed JSON (emitted
 # by ``benchmarks/analyze_capture.py --emit-thresholds``), not a code
@@ -357,12 +363,40 @@ def _ck_fused_batch(ctx: PathContext) -> Optional[str]:
 
 
 def _ck_paged_mesh(ctx: PathContext) -> Optional[str]:
-    if ctx.mesh:
+    # r18: the page pool is no longer a single-device arena — each
+    # metric shard owns its own page arena and the paged commit runs
+    # shard-local inside one shard_map (ops/paged_store.
+    # make_sharded_paged_commit_fn).  The edge now declines only the
+    # mesh SHAPES the sharded arenas genuinely cannot take.
+    if not ctx.mesh:
+        return None
+    mesh = ctx.mesh_obj
+    if mesh is None:
+        # bool-only callers carry no shape to inspect: admitted here;
+        # the same shape edges re-run wherever the Mesh is in hand
+        # (resolve_full_path, PagedStore's constructor backstop)
+        return None
+    from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
+
+    axes = tuple(getattr(mesh, "axis_names", ()))
+    if STREAM_AXIS not in axes or METRIC_AXIS not in axes:
         return (
-            "mesh shape: paged storage does not run on a sharded mesh "
-            "(the page pool is a single-device arena; the page table's "
-            "slot ids are meaningless across shards — the sharded path "
-            "keeps its dense row-sharded accumulator)"
+            f"mesh shape: mesh axes {axes!r} are not the "
+            f"('{STREAM_AXIS}', '{METRIC_AXIS}') layout the per-shard "
+            "page arenas partition over"
+        )
+    n_metric = mesh.shape[METRIC_AXIS]
+    if ctx.num_metrics and ctx.num_metrics % n_metric:
+        return (
+            f"mesh shape: num_metrics={ctx.num_metrics} rows don't "
+            f"shard evenly over the {n_metric}-way metric axis, so the "
+            "page arenas cannot split per shard"
+        )
+    n_stream = mesh.shape[STREAM_AXIS]
+    if PAGED_COMMIT_CHUNK % n_stream:
+        return (
+            f"mesh shape: the {PAGED_COMMIT_CHUNK}-triple paged commit "
+            f"chunk does not split over the {n_stream}-way stream axis"
         )
     return None
 
@@ -461,6 +495,32 @@ def _ck_fused_paged_transport(ctx: PathContext) -> Optional[str]:
     return None
 
 
+def _ck_fused_paged_mesh(ctx: PathContext) -> Optional[str]:
+    # Unlike the dense fused kernel (pallas_call under shard_map is not
+    # hardware-validated — _ck_fused_mesh stands), the sharded
+    # direct-to-paged step runs its scatter on the jnp tier inside
+    # shard_map (ops/fused_ingest.make_sharded_fused_paged_ingest_fn),
+    # so a mesh only declines on batch split shape.
+    if not ctx.mesh:
+        return None
+    mesh = ctx.mesh_obj
+    if mesh is None:
+        return None
+    from loghisto_tpu.parallel.mesh import STREAM_AXIS
+
+    axes = tuple(getattr(mesh, "axis_names", ()))
+    if STREAM_AXIS not in axes:
+        return None  # the pool_mesh edge names the axis-layout reason
+    n_stream = mesh.shape[STREAM_AXIS]
+    if ctx.batch_size is not None and ctx.batch_size % n_stream:
+        return (
+            f"mesh shape: batch_size={ctx.batch_size} samples don't "
+            f"split over the {n_stream}-way stream axis for the "
+            "shard_map-embedded direct-to-paged step"
+        )
+    return None
+
+
 def _ck_fused_paged_platform(ctx: PathContext) -> Optional[str]:
     if ctx.platform is not None and ctx.platform != "tpu":
         return (
@@ -498,7 +558,7 @@ CAPABILITY_TABLE: Dict[Tuple[str, str], Tuple[CapabilityEdge, ...]] = {
     ),
     ("ingest", "fused_paged"): (
         CapabilityEdge("switch", True, _ck_fused_paged_switch),
-        CapabilityEdge("mesh", False, _ck_fused_mesh),
+        CapabilityEdge("mesh", False, _ck_fused_paged_mesh),
         CapabilityEdge("pool_mesh", False, _ck_paged_mesh),
         CapabilityEdge("bucket_axis", False, _ck_paged_bucket_axis),
         CapabilityEdge("transport", False, _ck_fused_paged_transport),
@@ -578,6 +638,7 @@ def fused_paged_incapability(
     transport: str = "auto",
     platform: str | None = None,
     crossover: bool = True,
+    mesh_obj=None,
 ) -> str | None:
     """Why a configuration cannot (or should not) take the r17
     direct-to-paged fused ingest — the one-dispatch compress -> encode
@@ -585,11 +646,13 @@ def fused_paged_incapability(
     siblings: auto degrades (to the host-fold translate + packed pool
     commit) with the reason, an explicit ``ingest_path="fused"`` on a
     paged store raises it; ``crossover=False`` skips the policy edges
-    (platform preference, batch amortization, threshold switch)."""
+    (platform preference, batch amortization, threshold switch).
+    ``mesh_obj`` (the Mesh, when in hand) lets the r18 mesh edges check
+    the actual shard shape instead of blanket-declining."""
     ctx = PathContext(
         num_metrics=num_metrics, num_buckets=num_buckets,
         batch_size=batch_size, mesh=mesh, transport=transport,
-        platform=platform,
+        platform=platform, mesh_obj=mesh_obj,
     )
     hit = incapability("ingest", "fused_paged", ctx, include_policy=crossover)
     return None if hit is None else hit[1]
@@ -602,6 +665,7 @@ def paged_storage_incapability(
     transport: str = "sparse",
     crossover: bool = True,
     fused_ok: bool = False,
+    mesh_obj=None,
 ) -> str | None:
     """Why a configuration genuinely cannot (or should not) run the r14
     paged bucket backend, as a human-readable reason string — or None
@@ -617,7 +681,7 @@ def paged_storage_incapability(
     no host fold, so "raw" no longer disqualifies paged storage."""
     ctx = PathContext(
         num_metrics=num_metrics, num_buckets=num_buckets, mesh=mesh,
-        transport=transport, fused_ok=fused_ok,
+        transport=transport, fused_ok=fused_ok, mesh_obj=mesh_obj,
     )
     hit = incapability("storage", "paged", ctx, include_policy=crossover)
     return None if hit is None else hit[1]
@@ -802,6 +866,7 @@ def resolve_storage_path(
     mesh: bool = False,
     transport: str = "sparse",
     fused_ok: bool = False,
+    mesh_obj=None,
 ) -> tuple[str, str | None]:
     """Resolve the accumulator storage backend: "dense" (the donated
     [M, B] tensor) or "paged" (page pool + page table + per-row codecs,
@@ -832,7 +897,7 @@ def resolve_storage_path(
             return "dense", "paged storage disabled by threshold table"
         reason = paged_storage_incapability(
             num_metrics, num_buckets, mesh=mesh, transport=transport,
-            fused_ok=fused_ok,
+            fused_ok=fused_ok, mesh_obj=mesh_obj,
         )
         if reason is not None:
             return "dense", reason
@@ -845,7 +910,7 @@ def resolve_storage_path(
     if storage == "paged":
         reason = paged_storage_incapability(
             num_metrics, num_buckets, mesh=mesh, transport=transport,
-            crossover=False, fused_ok=fused_ok,
+            crossover=False, fused_ok=fused_ok, mesh_obj=mesh_obj,
         )
         if reason is not None:
             raise ValueError(f"paged storage unavailable: {reason}")
@@ -939,7 +1004,7 @@ def resolve_full_path(
     fp_reason = fused_paged_incapability(
         num_metrics, num_buckets, batch_size=batch_size, mesh=mesh_flag,
         transport=transport, platform=platform,
-        crossover=(ingest == "auto"),
+        crossover=(ingest == "auto"), mesh_obj=mesh_obj,
     )
     fused_ok = fp_reason is None and ingest in ("auto", "fused")
     if fp_reason is not None:
@@ -948,7 +1013,7 @@ def resolve_full_path(
     # 2. storage (may raise on explicit-invalid, same as before)
     storage_res, s_reason = resolve_storage_path(
         storage, num_metrics, num_buckets, platform, mesh=mesh_flag,
-        transport=transport, fused_ok=fused_ok,
+        transport=transport, fused_ok=fused_ok, mesh_obj=mesh_obj,
     )
     if s_reason is not None:
         reasons["storage:paged"] = s_reason

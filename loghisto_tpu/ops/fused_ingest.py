@@ -92,7 +92,11 @@ from loghisto_tpu.config import PRECISION
 from loghisto_tpu.ops.backend import default_interpret
 from loghisto_tpu.ops.ingest import bucket_indices
 from loghisto_tpu.ops.ingest import ingest_batch as fused_ingest_reference  # noqa: F401
-from loghisto_tpu.ops.paged_store import ZERO_SLOT, pallas_paged_scatter
+from loghisto_tpu.ops.paged_store import (
+    ZERO_SLOT,
+    paged_scatter_batch,
+    pallas_paged_scatter,
+)
 from loghisto_tpu.ops.pallas_kernels import LANES, SAMPLE_TILE
 
 # Metric rows per accumulator block resident in VMEM.  8 matches the
@@ -304,9 +308,16 @@ def fused_paged_ingest_batch(
     bucket_limit: int,
     precision: int = PRECISION,
     interpret: bool | None = None,
+    kernel: str = "pallas",
 ) -> jnp.ndarray:
     """Direct-to-paged fused step: raw (ids, values) -> donated pool
     [P, page_size] int32 in ONE Pallas dispatch.
+
+    ``kernel="jnp"`` swaps the final scatter for the XLA tier
+    (``paged_scatter_batch``) — bit-identical by the paged-store parity
+    pin, and legal inside shard_map where the per-cell DMA kernel is
+    not (the resolve_compact_path policy: Pallas stays the
+    single-device tier).
 
     The codec encode and page translate that paging.py performs on the
     host for the packed-commit path run here as three gathers on static
@@ -377,6 +388,8 @@ def fused_paged_ingest_batch(
     counts = jnp.where(keep, seg_counts[seg], 0)
     packed = jnp.stack([slots, offs, counts], axis=1).astype(jnp.int32)
 
+    if kernel == "jnp":
+        return paged_scatter_batch(pool, packed)
     # -- the ONE pallas_call of the program --
     return pallas_paged_scatter(pool, packed, interpret=interpret)
 
@@ -398,5 +411,88 @@ def make_fused_paged_ingest_fn(
             pool, ids, values, row_codec, enc_luts, page_table,
             bucket_limit, precision, interpret=interpret,
         )
+
+    return ingest
+
+
+def make_sharded_fused_paged_ingest_fn(
+    mesh,
+    rows_per_shard: int,
+    shard_pages: int,
+    bucket_limit: int,
+    precision: int = PRECISION,
+):
+    """Mesh tier of the direct-to-paged step — same operand contract as
+    ``make_fused_paged_ingest_fn`` (pool, ids, values, row_codec,
+    enc_luts, page_table) with the pool laid out as per-metric-shard
+    page arenas and the batch split over the stream axis.
+
+    Inside one shard_map each device (a) keeps the ids its metric shard
+    owns (re-based to local rows; foreign ids take the dropped filler),
+    (b) localizes its page-table slice's GLOBAL slots to arena-local
+    slots (rows only ever map pages from their own shard's arena —
+    PagedStore's allocation invariant — so this is a pure re-base; the
+    defensive mask drops anything else), (c) runs the whole
+    compress->encode->translate->fold->scatter body on its [N/n_stream]
+    batch slice with the jnp scatter tier, and (d) merges deltas with
+    ONE stream-axis psum.  int32 adds commute and every sample is owned
+    by exactly one metric shard, so the result is bit-identical to the
+    single-device fused ingest over the same batch.
+
+    ids.shape[0] must divide by the stream axis (the capability table
+    screens batch sizes); rows_per_shard bakes into the executable, so
+    PagedStore drops its cached fn on grow().
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS, shard_map
+
+    def _local(pool_local, ids, values, row_codec_local, enc_luts, tbl_local):
+        shard = jax.lax.axis_index(METRIC_AXIS)
+        local_ids = ids - shard * rows_per_shard
+        local_ids = jnp.where(
+            (local_ids >= 0) & (local_ids < rows_per_shard),
+            local_ids,
+            jnp.int32(-1),
+        )
+        local_tbl = tbl_local - shard * shard_pages
+        local_tbl = jnp.where(
+            (tbl_local >= 0)
+            & (local_tbl > ZERO_SLOT)
+            & (local_tbl < shard_pages),
+            local_tbl,
+            jnp.int32(-1),
+        )
+        delta = fused_paged_ingest_batch(
+            jnp.zeros_like(pool_local),
+            local_ids,
+            values,
+            row_codec_local,
+            enc_luts,
+            local_tbl,
+            bucket_limit,
+            precision,
+            kernel="jnp",
+        )
+        delta = jax.lax.psum(delta, STREAM_AXIS)
+        return pool_local + delta
+
+    sharded = shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(
+            P(METRIC_AXIS, None),
+            P(STREAM_AXIS),
+            P(STREAM_AXIS),
+            P(METRIC_AXIS),
+            P(),
+            P(METRIC_AXIS, None),
+        ),
+        out_specs=P(METRIC_AXIS, None),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def ingest(pool, ids, values, row_codec, enc_luts, page_table):
+        return sharded(pool, ids, values, row_codec, enc_luts, page_table)
 
     return ingest

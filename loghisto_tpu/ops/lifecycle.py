@@ -67,9 +67,18 @@ def make_touch_fn():
 
 
 @functools.lru_cache(maxsize=None)
-def make_fold_evict_fn(num_tiers: int):
+def make_fold_evict_fn(num_tiers: int, with_acc: bool = True):
     """Build the evict-fold program for ``num_tiers`` retention tiers.
 
+    ``with_acc=False`` is the paged-storage variant (r18): the lifetime
+    accumulator lives in the page pool, whose fold is a host translate +
+    pool commit (PagedStore.fold_rows_into) — so the device program
+    folds only the tier rings and stamps the activity vector:
+    ``fold(rings, last_active, victims, targets, epoch) -> (rings,
+    last_active)``.  Victim-count accounting moves to the pool fold's
+    exact host return value.
+
+    With ``with_acc=True`` (dense):
     ``fold(acc, rings, last_active, victims, targets, epoch) ->
     (acc, rings, last_active, victim_counts)`` where
 
@@ -96,12 +105,7 @@ def make_fold_evict_fn(num_tiers: int):
     overflow names), so add-then-zero ordering is safe.
     """
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-    def fold(acc, rings, last_active, victims, targets, epoch):
-        rows = jnp.take(acc, victims, axis=0, mode="fill", fill_value=0)
-        victim_counts = jnp.sum(rows, axis=1)
-        acc = acc.at[targets].add(rows, mode="drop")
-        acc = acc.at[victims].set(0, mode="drop")
+    def _fold_rings(rings, last_active, victims, targets, epoch):
         new_rings = []
         for t in range(num_tiers):
             ring = rings[t]
@@ -111,7 +115,26 @@ def make_fold_evict_fn(num_tiers: int):
             ring = ring.at[:, victims].set(0, mode="drop")
             new_rings.append(ring)
         last_active = last_active.at[victims].set(epoch, mode="drop")
-        return acc, tuple(new_rings), last_active, victim_counts
+        return tuple(new_rings), last_active
+
+    if not with_acc:
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def fold_paged(rings, last_active, victims, targets, epoch):
+            return _fold_rings(rings, last_active, victims, targets, epoch)
+
+        return fold_paged
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def fold(acc, rings, last_active, victims, targets, epoch):
+        rows = jnp.take(acc, victims, axis=0, mode="fill", fill_value=0)
+        victim_counts = jnp.sum(rows, axis=1)
+        acc = acc.at[targets].add(rows, mode="drop")
+        acc = acc.at[victims].set(0, mode="drop")
+        new_rings, last_active = _fold_rings(
+            rings, last_active, victims, targets, epoch
+        )
+        return acc, new_rings, last_active, victim_counts
 
     return fold
 
@@ -202,10 +225,17 @@ def resolve_compact_path(path: str, platform: str, mesh: bool) -> str:
 
 
 @functools.lru_cache(maxsize=None)
-def make_compact_fn(num_tiers: int, path: str = "jnp"):
+def make_compact_fn(num_tiers: int, path: str = "jnp",
+                    with_acc: bool = True):
     """Build the full-repack program: one donated-carry dispatch that
     reorders the accumulator, every tier ring, and the activity vector
     over the survivor permutation.
+
+    ``with_acc=False`` is the paged-storage variant (r18): the pool
+    repacks on host (PagedStore.apply_permutation permutes page-table
+    ROWS — zero device data movement), so the device program handles
+    only the rings and the activity vector:
+    ``compact(rings, last_active, perm, epoch) -> (rings, last_active)``.
 
     ``compact(acc, rings, last_active, perm, epoch) ->
     (acc, rings, last_active)`` where ``perm`` is int32 [M] with
@@ -225,9 +255,7 @@ def make_compact_fn(num_tiers: int, path: str = "jnp"):
             return compact_rows_pallas(arr2d, perm)
         return compact_rows(arr2d, perm)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-    def compact(acc, rings, last_active, perm, epoch):
-        acc = repack(acc, perm)
+    def _compact_rings(rings, last_active, perm, epoch):
         new_rings = []
         for t in range(num_tiers):
             ring = rings[t]
@@ -247,7 +275,23 @@ def make_compact_fn(num_tiers: int, path: str = "jnp"):
         )
         empty = (perm < 0) | (perm >= last_active.shape[0])
         last_active = jnp.where(empty, epoch, la)
-        return acc, tuple(new_rings), last_active
+        return tuple(new_rings), last_active
+
+    if not with_acc:
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def compact_paged(rings, last_active, perm, epoch):
+            return _compact_rings(rings, last_active, perm, epoch)
+
+        return compact_paged
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def compact(acc, rings, last_active, perm, epoch):
+        acc = repack(acc, perm)
+        new_rings, last_active = _compact_rings(
+            rings, last_active, perm, epoch
+        )
+        return acc, new_rings, last_active
 
     return compact
 

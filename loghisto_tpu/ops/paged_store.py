@@ -187,6 +187,60 @@ def make_paged_commit_fn(kernel: str = "jnp"):
     return commit
 
 
+def make_sharded_paged_commit_fn(mesh, shard_pages: int):
+    """Mesh tier of the paged commit: ``f(pool, packed) -> pool`` with
+    pool int32 [n_metric * shard_pages, page_size] laid out as one
+    contiguous page arena per metric shard (shard k owns global slots
+    [k*shard_pages, (k+1)*shard_pages), slot k*shard_pages being that
+    shard's local zero page), and packed [n, 3] GLOBAL-slot triples
+    split over the stream axis.
+
+    Inside one shard_map each device keeps only the triples whose slot
+    falls in its metric shard's arena (re-based to local slots — the
+    local zero page and every foreign slot drop), scatters them into a
+    zero local delta, and ONE psum over the stream axis merges the
+    deltas.  Every triple is owned by exactly one metric shard and
+    int32 adds commute, so the result is bit-identical to the
+    single-device ``make_paged_commit_fn`` over the same pool — the
+    PR-8 sharded-commit recipe applied to pages instead of rows.  The
+    scatter body is the jnp tier (shard_map-local XLA scatter); the
+    Pallas per-cell DMA tier stays single-device, matching
+    resolve_compact_path's policy.
+
+    Host-side contract: the padded triple count must divide by the
+    stream axis size (COMMIT_CHUNK is a power of two, so any pow-2
+    stream axis works; paging.py guards this at construction).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS, shard_map
+
+    def _local(pool_local, packed):
+        shard = jax.lax.axis_index(METRIC_AXIS)
+        local = packed[:, 0] - shard * shard_pages
+        own = (local > ZERO_SLOT) & (local < shard_pages)
+        lp = jnp.stack(
+            [jnp.where(own, local, jnp.int32(-1)), packed[:, 1], packed[:, 2]],
+            axis=1,
+        )
+        delta = paged_scatter_batch(jnp.zeros_like(pool_local), lp)
+        delta = jax.lax.psum(delta, STREAM_AXIS)
+        return pool_local + delta
+
+    sharded = shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(METRIC_AXIS, None), P(STREAM_AXIS, None)),
+        out_specs=P(METRIC_AXIS, None),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def commit(pool, packed):
+        return sharded(pool, packed)
+
+    return commit
+
+
 def gather_storage_rows(
     pool: jnp.ndarray, table_rows: jnp.ndarray, storage_buckets: int
 ) -> jnp.ndarray:

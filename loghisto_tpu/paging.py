@@ -231,13 +231,29 @@ class PagedStore:
     ):
         from loghisto_tpu.ops.paged_store import validate_pool_shape
 
-        if mesh is not None:
-            raise ValueError(
-                "paged storage is single-device for now: the page pool "
-                "is not metric-row-sharded (ops/dispatch."
-                "paged_storage_incapability)"
-            )
         validate_pool_shape(config.pool_pages, config.page_size)
+        self.mesh = mesh
+        self._n_shards = 1
+        self._n_stream = 1
+        if mesh is not None:
+            # dispatch.py's capability table pre-screens these shapes
+            # ("mesh shape:" reasons); the raises here are backstops for
+            # direct construction.
+            from loghisto_tpu.ops.paged_store import COMMIT_CHUNK
+            from loghisto_tpu.parallel.mesh import METRIC_AXIS, STREAM_AXIS
+
+            self._n_shards = int(mesh.shape[METRIC_AXIS])
+            self._n_stream = int(mesh.shape[STREAM_AXIS])
+            if num_metrics % self._n_shards:
+                raise ValueError(
+                    f"num_metrics={num_metrics} not divisible by the "
+                    f"{self._n_shards}-way metric axis"
+                )
+            if COMMIT_CHUNK % self._n_stream:
+                raise ValueError(
+                    f"COMMIT_CHUNK={COMMIT_CHUNK} not divisible by the "
+                    f"{self._n_stream}-way stream axis"
+                )
         self.config = config
         self.bucket_limit = int(bucket_limit)
         self.precision = int(precision)
@@ -270,18 +286,55 @@ class PagedStore:
         self.page_table = np.full(
             (self.num_metrics, self.pages_per_row), -1, dtype=np.int32
         )
-        self._free: List[int] = list(
-            range(config.pool_pages - 1, 0, -1)
-        )  # slot 0 reserved zero page
+        # Page arenas: metric shard k owns the contiguous GLOBAL slot
+        # range [k*shard_pages, (k+1)*shard_pages), with the range base
+        # slot reserved as that shard's local zero page (so shard_map's
+        # re-based local slots keep the slot-0-is-zero-page contract).
+        # The page table always stores global slots; rows only ever map
+        # pages from their own shard's arena (_alloc, and the
+        # permutation/grow migration below, maintain the invariant the
+        # sharded fused ingest relies on).  Single-device is the
+        # degenerate 1-shard case of the same layout.
+        self.rows_per_shard = self.num_metrics // self._n_shards
+        self.shard_pages = config.pool_pages
+        self.total_pages = self._n_shards * config.pool_pages
+        validate_pool_shape(self.total_pages, page)
+        self._free_lists: List[List[int]] = [
+            list(
+                range((k + 1) * self.shard_pages - 1, k * self.shard_pages, -1)
+            )
+            for k in range(self._n_shards)
+        ]
 
         import jax.numpy as jnp
 
-        from loghisto_tpu.ops.paged_store import make_paged_commit_fn
-
-        self._pool = jnp.zeros(
-            (config.pool_pages, page), dtype=jnp.int32
+        from loghisto_tpu.ops.paged_store import (
+            make_paged_commit_fn,
+            make_sharded_paged_commit_fn,
         )
-        self._commit = make_paged_commit_fn(kernel)
+
+        pool = jnp.zeros((self.total_pages, page), dtype=jnp.int32)
+        if mesh is not None:
+            import jax
+
+            from loghisto_tpu.parallel.mesh import (
+                pool_sharding,
+                triple_sharding,
+            )
+
+            from loghisto_tpu.parallel.multihost import global_put
+
+            self._pool_sharding = pool_sharding(mesh)
+            self._triple_sharding = triple_sharding(mesh)
+            pool = global_put(np.zeros(pool.shape, np.int32), self._pool_sharding)
+            self._commit = make_sharded_paged_commit_fn(
+                mesh, self.shard_pages
+            )
+        else:
+            self._pool_sharding = None
+            self._triple_sharding = None
+            self._commit = make_paged_commit_fn(kernel)
+        self._pool = pool
 
         # exact host spill for cells no page can hold (pool saturated
         # and the overflow row unavailable): {(row, native dense idx):
@@ -353,6 +406,13 @@ class PagedStore:
 
     # -- allocation ----------------------------------------------------- #
 
+    def _shard_of_row(self, row: int) -> int:
+        return int(row) // self.rows_per_shard
+
+    def _free_for(self, row: int) -> List[int]:
+        """The free list of the shard arena ``row`` allocates from."""
+        return self._free_lists[self._shard_of_row(row)]
+
     def _reserve_overflow_pages(self, row: int) -> None:
         """The overflow row must never itself fail to allocate: map its
         (coarse-codec) pages eagerly at construction."""
@@ -360,22 +420,25 @@ class PagedStore:
         codec = self._codecs[self.row_codec[row]]
         page = self.config.page_size
         n_pages = -(-codec.storage_buckets // page)
+        free = self._free_for(row)
         for p in range(n_pages):
             if self.page_table[row, p] < 0:
-                if not self._free:
+                if not free:
                     raise ValueError(
                         "pool too small to reserve the overflow row's "
                         f"{n_pages} pages; raise pool_pages"
                     )
-                self.page_table[row, p] = self._free.pop()
+                self.page_table[row, p] = free.pop()
                 self.allocated_pages += 1
         self._mirror = None
 
     def _alloc(self, row: int, page_idx: int) -> int:
-        """One page allocation; returns the slot or -1 when saturated."""
-        if not self._free:
+        """One page allocation from the row's own shard arena; returns
+        the global slot or -1 when that arena is saturated."""
+        free = self._free_for(row)
+        if not free:
             return -1
-        slot = self._free.pop()
+        slot = free.pop()
         self.page_table[row, page_idx] = slot
         self.allocated_pages += 1
         self._mirror = None
@@ -383,17 +446,34 @@ class PagedStore:
 
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        return sum(len(fl) for fl in self._free_lists)
 
     @property
     def occupied_pages(self) -> int:
-        return self.config.pool_pages - 1 - len(self._free)
+        return self._n_shards * (self.shard_pages - 1) - self.free_pages
+
+    def shard_free_pages(self) -> List[int]:
+        """Free pages remaining in each metric shard's arena."""
+        return [len(fl) for fl in self._free_lists]
+
+    def shard_occupancy(self) -> List[float]:
+        """Occupied fraction of each shard arena (zero page excluded).
+        The per-shard view matters because saturation is per-arena: one
+        hot shard starts overflowing/spilling while the pool-wide
+        average still looks healthy."""
+        cap = max(1, self.shard_pages - 1)
+        return [1.0 - len(fl) / cap for fl in self._free_lists]
+
+    def pool_saturation(self) -> float:
+        """Worst shard-arena occupancy in [0, 1] — the /healthz
+        watchdog's pool_saturation invariant reads this."""
+        return max(self.shard_occupancy())
 
     def hbm_bytes(self) -> int:
         """Device-resident footprint: the pool plus the (host) table's
         device-mirrorable size — what the 1M-row budget is measured
         against (benchmarks/paged_store.py)."""
-        pool = self.config.pool_pages * self.config.page_size * 4
+        pool = self.total_pages * self.config.page_size * 4
         table = self.page_table.size * 4
         return pool + table
 
@@ -486,20 +566,51 @@ class PagedStore:
         )
         n = len(dev)
         if n:
-            import jax.numpy as jnp
-
             padded = -(-n // COMMIT_CHUNK) * COMMIT_CHUNK
             if padded != n:
                 pad = np.zeros((padded - n, 3), dtype=np.int32)
                 pad[:, 0] = -1
                 dev = np.concatenate([dev, pad])
-            self._pool = self._commit(self._pool, jnp.asarray(dev))
+            self._pool = self._commit(self._pool, self._put_triples(dev))
             self.commits += 1
             self.last_h2d_bytes = dev.nbytes
             self.h2d_bytes += dev.nbytes
         else:
             self.last_h2d_bytes = 0
         return applied + spilled
+
+    def _put_triples(self, dev: np.ndarray):
+        """Upload translated triples — split over the stream axis under
+        a mesh (COMMIT_CHUNK padding keeps the length divisible)."""
+        import jax.numpy as jnp
+
+        if self._triple_sharding is None:
+            return jnp.asarray(dev)
+        from loghisto_tpu.parallel.multihost import global_put
+
+        return global_put(dev, self._triple_sharding)
+
+    def _place_pool(self, pool):
+        """Re-pin the pool's metric-shard placement after an op (host
+        scatter, reset) that may have produced an unsharded result."""
+        if self._pool_sharding is None:
+            return pool
+        import jax
+
+        if isinstance(pool, jax.Array):
+            if (
+                pool.sharding == self._pool_sharding
+                or not pool.is_fully_addressable
+            ):
+                # already placed, or a multi-process global array the
+                # next jitted dispatch re-shards itself (an eager
+                # device_put would need a collective the CPU drill
+                # lacks)
+                return pool
+            return jax.device_put(pool, self._pool_sharding)
+        from loghisto_tpu.parallel.multihost import global_put
+
+        return global_put(pool, self._pool_sharding)
 
     def warmup(self) -> None:
         """Pre-compile THE commit executable (one all-pad COMMIT_CHUNK
@@ -510,11 +621,9 @@ class PagedStore:
         rationale, applied to the paged wire)."""
         from loghisto_tpu.ops.paged_store import COMMIT_CHUNK
 
-        import jax.numpy as jnp
-
         pad = np.zeros((COMMIT_CHUNK, 3), dtype=np.int32)
         pad[:, 0] = -1
-        self._pool = self._commit(self._pool, jnp.asarray(pad))
+        self._pool = self._commit(self._pool, self._put_triples(pad))
 
     # -- fused direct-to-paged ingest (r17) ------------------------------ #
 
@@ -525,11 +634,36 @@ class PagedStore:
         if self._mirror is None:
             import jax.numpy as jnp
 
-            self._mirror = (
-                jnp.asarray(self.row_codec, dtype=jnp.int32),
-                jnp.asarray(self._enc),
-                jnp.asarray(self.page_table),
-            )
+            rc = jnp.asarray(self.row_codec, dtype=jnp.int32)
+            enc = jnp.asarray(self._enc)
+            tbl = jnp.asarray(self.page_table)
+            if self.mesh is not None:
+                # pre-place so the jitted shard_map never re-shards the
+                # cached mirrors per dispatch: row_codec and the table
+                # split over the metric axis, the enc LUTs replicate.
+                # global_put keeps this collective-free across real
+                # jax.distributed processes (host tables are identical
+                # on every process by construction)
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                from loghisto_tpu.parallel.mesh import METRIC_AXIS
+                from loghisto_tpu.parallel.multihost import global_put
+
+                rc = global_put(
+                    self.row_codec.astype(np.int32),
+                    NamedSharding(self.mesh, PartitionSpec(METRIC_AXIS)),
+                )
+                enc = global_put(
+                    np.asarray(self._enc),
+                    NamedSharding(self.mesh, PartitionSpec()),
+                )
+                tbl = global_put(
+                    np.asarray(self.page_table),
+                    NamedSharding(
+                        self.mesh, PartitionSpec(METRIC_AXIS, None)
+                    ),
+                )
+            self._mirror = (rc, enc, tbl)
         return self._mirror
 
     def prepare_batch(
@@ -621,13 +755,26 @@ class PagedStore:
 
     def _fused_ingest_fn(self):
         if self._fused_fn is None:
-            from loghisto_tpu.ops.fused_ingest import (
-                make_fused_paged_ingest_fn,
-            )
+            if self.mesh is not None:
+                from loghisto_tpu.ops.fused_ingest import (
+                    make_sharded_fused_paged_ingest_fn,
+                )
 
-            self._fused_fn = make_fused_paged_ingest_fn(
-                self.bucket_limit, self.precision
-            )
+                self._fused_fn = make_sharded_fused_paged_ingest_fn(
+                    self.mesh,
+                    self.rows_per_shard,
+                    self.shard_pages,
+                    self.bucket_limit,
+                    self.precision,
+                )
+            else:
+                from loghisto_tpu.ops.fused_ingest import (
+                    make_fused_paged_ingest_fn,
+                )
+
+                self._fused_fn = make_fused_paged_ingest_fn(
+                    self.bucket_limit, self.precision
+                )
         return self._fused_fn
 
     def ingest_raw(self, ids_dev, values_dev) -> None:
@@ -661,9 +808,20 @@ class PagedStore:
         accounted by the caller's shed path."""
         import jax.numpy as jnp
 
-        self._pool = jnp.zeros(
-            (self.config.pool_pages, self.config.page_size), dtype=jnp.int32
-        )
+        if self._pool_sharding is None:
+            self._pool = jnp.zeros(
+                (self.total_pages, self.config.page_size), dtype=jnp.int32
+            )
+        else:
+            # host zeros through the collective-free global placement
+            # (an eager device_put of a local jnp array cannot commit
+            # onto a multi-process sharding on the CPU drill backend)
+            self._pool = self._place_pool(
+                np.zeros(
+                    (self.total_pages, self.config.page_size),
+                    dtype=np.int32,
+                )
+            )
 
     def spill_pool(self) -> None:
         """Fold every device count into the exact host spill and zero
@@ -686,6 +844,42 @@ class PagedStore:
                 key = (int(r), int(d))
                 self._host_spill[key] = self._host_spill.get(key, 0) + int(w)
 
+    def spill_triples(self, triples: np.ndarray) -> int:
+        """Failure-path exactness: fold already-TRANSLATED ``(slot,
+        offset, count)`` triples back into the host spill by inverting
+        the page table (slot -> owning row/page -> codec decode).  The
+        fused committer uses this for the one chunk whose translate ran
+        but whose dispatch failed — its host-spill portion was applied
+        inside translate, so only the device portion (these triples)
+        must re-land, and spilling the chunk's CELLS would double-count.
+        Returns the total count folded."""
+        triples = np.asarray(triples)
+        triples = triples[triples[:, 0] > 0]
+        if not len(triples):
+            return 0
+        owner_row = np.full(self.total_pages, -1, dtype=np.int64)
+        owner_page = np.zeros(self.total_pages, dtype=np.int64)
+        mapped = self.page_table >= 0
+        rows_of, pages_of = np.nonzero(mapped)
+        slots_of = self.page_table[rows_of, pages_of]
+        owner_row[slots_of] = rows_of
+        owner_page[slots_of] = pages_of
+        rows = owner_row[triples[:, 0]]
+        keep = rows >= 0  # a since-released page's counts were folded
+        rows = rows[keep]
+        if not len(rows):
+            return 0
+        page = self.config.page_size
+        storage = owner_page[triples[:, 0]][keep] * page + triples[keep, 1]
+        counts = triples[keep, 2].astype(np.int64)
+        codec = self.row_codec[rows]
+        dense = np.zeros(len(rows), dtype=np.int64)
+        for cid in np.unique(codec):
+            sel = codec == cid
+            dense[sel] = self._codecs[cid].dec_lut[storage[sel]]
+        self.spill_cells(rows, dense, counts)
+        return int(counts.sum())
+
     # -- decode / stats -------------------------------------------------- #
 
     def _decode_pool_cells(
@@ -697,7 +891,12 @@ class PagedStore:
         LUTs are injective per codec), but two storage buckets of
         DIFFERENT rows may share a pool page only if mapped there, so
         ownership comes from the page table, not the pool."""
-        pool_np = np.asarray(self._pool)
+        from loghisto_tpu.parallel.multihost import host_gather
+
+        # multi-process safe: a pool sharded across real jax.distributed
+        # processes is only partially addressable here, so the D2H copy
+        # allgathers (single-process it is a plain np.asarray)
+        pool_np = host_gather(self._pool)
         # slot -> (row, page_idx) ownership from the table
         mapped = self.page_table >= 0
         rows_of, pages_of = np.nonzero(mapped)
@@ -855,7 +1054,9 @@ class PagedStore:
         slots = self.page_table[rows].reshape(-1)
         slots = slots[slots >= 0]
         if len(slots):
-            self._pool = self._pool.at[jnp.asarray(slots)].set(0)
+            self._pool = self._place_pool(
+                self._pool.at[jnp.asarray(slots)].set(0)
+            )
 
     def release_rows(self, rows: List[int]) -> int:
         """Return every page mapped by ``rows`` to the free pool (pages
@@ -866,7 +1067,9 @@ class PagedStore:
             for p in range(self.pages_per_row):
                 slot = int(self.page_table[r, p])
                 if slot > 0:
-                    self._free.append(slot)
+                    # slots return to the arena they came from (always
+                    # the row's shard, by the allocation invariant)
+                    self._free_lists[slot // self.shard_pages].append(slot)
                     self.page_table[r, p] = -1
                     freed += 1
             self.row_codec[r] = -1
@@ -874,11 +1077,71 @@ class PagedStore:
         self._mirror = None
         return freed
 
+    def drop_rows(self, rows: List[int]) -> None:
+        """Discard victims entirely (eviction with a shed target): zero
+        their pages, return them to the free lists, clear their codecs,
+        and purge their host-spill cells.  The caller accounts the shed
+        counts (lifecycle's overflowed-samples path)."""
+        rows = [int(r) for r in rows]
+        if not rows:
+            return
+        self._zero_rows(rows)
+        self.release_rows(rows)
+        with self._lock:
+            dead = set(rows)
+            self._host_spill = {
+                k: v for k, v in self._host_spill.items() if k[0] not in dead
+            }
+
+    def _extract_rows(self, rows: List[int]) -> np.ndarray:
+        """Pull the given rows' pool cells out as packed (row, centered
+        codec bucket, count) triples, zero and free their pages, and
+        clear their table entries — KEEPING row_codec, so a later
+        commit() re-lands them under the same codec (the cross-shard
+        migration step of apply_permutation/grow)."""
+        rows = [int(r) for r in rows]
+        if not rows:
+            return np.empty((0, 3), dtype=np.int32)
+        all_rows, all_idx, all_counts = self._decode_pool_cells()
+        sel = np.isin(all_rows, rows)
+        packed = np.empty((int(sel.sum()), 3), dtype=np.int32)
+        packed[:, 0] = all_rows[sel]
+        packed[:, 1] = all_idx[sel] - self.bucket_limit
+        packed[:, 2] = all_counts[sel]
+        self._zero_rows(rows)
+        for r in rows:
+            for p in range(self.pages_per_row):
+                slot = int(self.page_table[r, p])
+                if slot > 0:
+                    self._free_lists[slot // self.shard_pages].append(slot)
+                    self.page_table[r, p] = -1
+                    self.released_pages += 1
+        self._mirror = None
+        return packed
+
     def apply_permutation(self, perm: List[int], m_rows: int) -> None:
         """Survivor repack: row r of the new layout takes old row
         perm[r] (-1 = hole -> unmapped).  Pure host table permutation —
         pool pages never move, so compaction is O(M) with zero device
-        traffic (vs the dense path's full gather/scatter repack)."""
+        traffic (vs the dense path's full gather/scatter repack).
+
+        Under a multi-shard mesh, survivors whose new id lands in a
+        DIFFERENT metric shard can't keep their old-arena pages (the
+        row-pages-in-own-shard invariant): their cells are extracted
+        first (pages freed back to the old arena, codec kept) and
+        recommitted under their new ids after the permutation, which
+        re-allocates pages from the new shard's arena."""
+        movers: List[int] = []
+        remap_new: Dict[int, int] = {}
+        if self._n_shards > 1:
+            for new_id, old_id in enumerate(perm[:m_rows]):
+                if old_id is None or old_id < 0:
+                    continue
+                if self._shard_of_row(old_id) != self._shard_of_row(new_id):
+                    movers.append(int(old_id))
+                    remap_new[int(old_id)] = int(new_id)
+        packed = self._extract_rows(movers) if movers else None
+
         new_table = np.full_like(self.page_table, -1)
         new_codec = np.full_like(self.row_codec, -1)
         for new_id, old_id in enumerate(perm[:m_rows]):
@@ -901,10 +1164,34 @@ class PagedStore:
                 if nr is not None:
                     spill[(nr, d)] = spill.get((nr, d), 0) + v
             self._host_spill = spill
+        if packed is not None and len(packed):
+            packed[:, 0] = np.array(
+                [remap_new[int(r)] for r in packed[:, 0]], dtype=np.int32
+            )
+            self.commit(packed)
 
     def grow(self, new_m: int) -> None:
         if new_m <= self.num_metrics:
             return
+        packed = None
+        if self._n_shards > 1:
+            if new_m % self._n_shards:
+                raise ValueError(
+                    f"grown num_metrics={new_m} not divisible by the "
+                    f"{self._n_shards}-way metric axis"
+                )
+            # growth re-draws the shard boundaries (rows_per_shard
+            # changes): rows whose owning shard changes migrate — cells
+            # out, pages freed to the old arena, codec kept, recommit
+            # below re-allocates from the new arena
+            new_rps = new_m // self._n_shards
+            movers = [
+                r
+                for r in range(self.num_metrics)
+                if r // self.rows_per_shard != r // new_rps
+                and np.any(self.page_table[r] >= 0)
+            ]
+            packed = self._extract_rows(movers) if movers else None
         extra = new_m - self.num_metrics
         self.page_table = np.concatenate(
             [
@@ -916,7 +1203,16 @@ class PagedStore:
             [self.row_codec, np.full(extra, -1, dtype=np.int8)]
         )
         self.num_metrics = new_m
+        self.rows_per_shard = self.num_metrics // self._n_shards
         self._mirror = None
+        # the sharded fused-ingest executable bakes rows_per_shard
+        self._fused_fn = None if self._n_shards > 1 else self._fused_fn
+        if packed is not None and len(packed):
+            self.commit(packed)
+        if self._n_shards > 1 and self.config.overflow_row is not None:
+            # a migrated overflow row gets its reserved pages back
+            # eagerly (idempotent for unmoved rows)
+            self._reserve_overflow_pages(self.config.overflow_row)
 
     def max_cell(self) -> int:
         """Largest single pool count (spill-threshold headroom checks)."""

@@ -276,11 +276,18 @@ def make_sharded_accumulator(
 ) -> jnp.ndarray:
     """Zero accumulator laid out metric-sharded, stream-replicated
     (the canonical acc layout from parallel.mesh, shared with the
-    sharded fused commit and checkpoint restore)."""
-    from loghisto_tpu.parallel.mesh import acc_sharding
+    sharded fused commit and checkpoint restore).  global_put keeps
+    the placement collective-free when the mesh spans real
+    jax.distributed processes (a plain device_put onto a
+    non-addressable sharding runs an assert-equal collective the CPU
+    drill backend lacks)."""
+    import numpy as np
 
-    return jax.device_put(
-        jnp.zeros((num_metrics, num_buckets), dtype=jnp.int32),
+    from loghisto_tpu.parallel.mesh import acc_sharding
+    from loghisto_tpu.parallel.multihost import global_put
+
+    return global_put(
+        np.zeros((num_metrics, num_buckets), dtype=np.int32),
         acc_sharding(mesh),
     )
 
@@ -737,7 +744,7 @@ class TPUAggregator:
         self.fused_paged_reason = fused_paged_incapability(
             num_metrics, config.num_buckets, batch_size=batch_size,
             mesh=mesh is not None, transport=transport, platform=backend,
-            crossover=(ingest_path == "auto"),
+            crossover=(ingest_path == "auto"), mesh_obj=mesh,
         )
         fused_paged_ok = (
             self.fused_paged_reason is None
@@ -747,6 +754,7 @@ class TPUAggregator:
             storage, num_metrics, config.num_buckets,
             backend, mesh=mesh is not None,
             transport=transport, fused_ok=fused_paged_ok,
+            mesh_obj=mesh,
         )
         self.paged = None
         self.fused_paged = self.storage == "paged" and fused_paged_ok
@@ -794,10 +802,7 @@ class TPUAggregator:
                     f"num_metrics={num_metrics} not divisible by the mesh "
                     f"metric axis ({n_metric})"
                 )
-            self._acc = make_sharded_accumulator(
-                mesh, num_metrics, config.num_buckets
-            )
-        elif self.storage == "paged":
+        if self.storage == "paged":
             from loghisto_tpu.paging import PagedStore, PagedStoreConfig
 
             if ingest_path == "multirow":
@@ -806,11 +811,16 @@ class TPUAggregator:
                     "accumulator; paged storage keeps none (every paged "
                     "commit rides the packed sparse-triple scatter)"
                 )
+            # r18: a mesh shards the store itself — per-shard page
+            # arenas, shard-local translate/scatter inside one
+            # shard_map (the capability table's relaxed "mesh shape:"
+            # edges pre-screened the divisibility constraints)
             self.paged = PagedStore(
                 num_metrics,
                 config.bucket_limit,
                 config.precision,
                 config=paged_config or PagedStoreConfig(),
+                mesh=mesh,
             )
             # no dense [M, B] tensor exists in paged mode — the pool +
             # page table ARE the accumulator.  Every _acc touch below is
@@ -824,6 +834,10 @@ class TPUAggregator:
                     "direct-to-paged fused kernel: "
                     f"{self.fused_paged_reason}"
                 )
+        elif mesh is not None:
+            self._acc = make_sharded_accumulator(
+                mesh, num_metrics, config.num_buckets
+            )
         else:
             self._acc = jnp.zeros(
                 (num_metrics, config.num_buckets), dtype=jnp.int32
@@ -2317,4 +2331,45 @@ class TPUAggregator:
             ms.register_gauge_func(
                 "tpu.PagedLastCommitH2DBytes",
                 lambda: float(self.paged.last_h2d_bytes),
+            )
+            # paging.* family (ISSUE 18): the per-shard arena view the
+            # /healthz pool_saturation invariant alerts on.  Saturation
+            # is shard-local — one hot metric shard spills while the
+            # pod-wide tpu.PagedFreePages still looks roomy
+            ms.register_gauge_func(
+                "paging.PoolSaturation",
+                lambda: float(self.paged.pool_saturation()),
+            )
+            ms.register_gauge_func(
+                "paging.ShardFreePagesMin",
+                lambda: float(min(self.paged.shard_free_pages())),
+            )
+            for k in range(self.paged._n_shards):
+                ms.register_gauge_func(
+                    f"paging.Shard{k}Occupancy",
+                    lambda k=k: float(self.paged.shard_occupancy()[k]),
+                )
+            ms.register_gauge_func(
+                "paging.AllocatedPages",
+                lambda: float(self.paged.allocated_pages),
+            )
+
+            def _alloc_rate(state={"n": 0, "t": None}):
+                # pages/s since the previous scrape: cumulative counts
+                # need dashboard-side deltas; the reaper cadence makes
+                # this self-describing instead
+                import time as _time
+
+                now = _time.monotonic()
+                n = int(self.paged.allocated_pages)
+                last_n, last_t = state["n"], state["t"]
+                state["n"], state["t"] = n, now
+                if last_t is None or now <= last_t:
+                    return 0.0
+                return max(0.0, (n - last_n) / (now - last_t))
+
+            ms.register_gauge_func("paging.PageAllocRate", _alloc_rate)
+            ms.register_gauge_func(
+                "paging.SpilledCells",
+                lambda: float(self.paged.spilled_cells),
             )

@@ -66,6 +66,20 @@ def cell_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(STREAM_AXIS))
 
 
+def pool_sharding(mesh: Mesh) -> NamedSharding:
+    """int32 [total_pages, page_size] page pools: each metric shard owns
+    a contiguous arena of pool rows (its own zero page at the arena
+    base), so the paged scatter runs shard-local under shard_map."""
+    return NamedSharding(mesh, PartitionSpec(METRIC_AXIS, None))
+
+
+def triple_sharding(mesh: Mesh) -> NamedSharding:
+    """Translated commit triples [N, 3]: split over the stream axis
+    like cell chunks — each device scatters its slice into a local pool
+    delta and ONE psum merges them (int32 ⇒ order-independent)."""
+    return NamedSharding(mesh, PartitionSpec(STREAM_AXIS, None))
+
+
 def make_mesh(
     stream: Optional[int] = None,
     metric: int = 1,

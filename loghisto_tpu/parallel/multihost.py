@@ -88,6 +88,48 @@ def local_sample_shard(global_batch: int) -> tuple[int, int]:
     return positions[0] * per_device, len(positions) * per_device
 
 
+def global_put(host, sharding):
+    """Place a host array onto a (possibly multi-process) sharding.
+
+    Single-process this is ``jax.device_put``.  Across real
+    ``jax.distributed`` processes a plain device_put onto a
+    non-addressable sharding runs an assert-equal COLLECTIVE before
+    committing — unavailable on the CPU backend the 2-process drill
+    uses — so the global array is assembled collective-free via
+    ``make_array_from_callback``, each process slicing its addressable
+    shards out of the host value.  Callers guarantee every process
+    passes the SAME host value (the paged store's translate step is
+    deterministic from shared inputs, which is the whole multi-process
+    design: identical host tables, no coordination)."""
+    import numpy as np
+
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(host, sharding)
+    host = np.asarray(host)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx]
+    )
+
+
+def host_gather(arr):
+    """A host numpy copy of ``arr`` regardless of process span.
+
+    Single-process (including virtual multi-device CPU meshes) this is
+    plain ``np.asarray``.  Across real ``jax.distributed`` processes a
+    sharded array is only partially addressable, so the copy rides
+    ``process_allgather`` — every process receives the full global
+    value.  The paged store's decode path (checkpoint gather-on-save,
+    ``decode_dense``) funnels through here, which is what makes v3
+    paged checkpoints writable from any process of a multi-host pod."""
+    import numpy as np
+
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
 def make_global_arrays(mesh, ids_local, values_local):
     """Assemble global sample arrays from per-host local shards — each
     host supplies only its own samples; no host materializes the global

@@ -33,7 +33,12 @@ from loghisto_tpu.metrics import MetricSystem
 # storage mode restores any save), and `pg_codec_names` records each
 # row's codec choice so a paged restore re-pins resolutions instead of
 # re-deriving them from the first post-restore interval.  v1/v2 files
-# load fine — codecs None.
+# load fine — codecs None.  The same two legs make v3 files
+# MESH-SHAPE-portable (PR 18): decode_dense gathers the sharded pool
+# to one host tensor on save, and restore replays through the target
+# store's own translate/commit, which assigns pages against the
+# target mesh's per-shard arenas — a 2x4 save restores onto 1x8, an
+# unsharded store, or a dense aggregator, codec choices intact.
 FORMAT_VERSION = 3
 
 

@@ -106,6 +106,35 @@ def main() -> int:
     )
     np.testing.assert_array_equal(counts2, 2 * expected)
 
+    # paged sharded-commit drill (ISSUE 18): the page-pool substrate
+    # spans the same real process boundary.  Every process derives the
+    # SAME global packed delta, so the host-side translate step (page
+    # table, free lists, codec choices) agrees across processes without
+    # coordination; the device scatter + stream psum run inside one
+    # shard_map over the global mesh, and decode funnels through
+    # multihost.host_gather because the pool is only partially
+    # addressable from either process.
+    from loghisto_tpu.paging import PagedStore, PagedStoreConfig
+
+    pg = PagedStore(
+        m, cfg.bucket_limit, cfg.precision,
+        config=PagedStoreConfig(pool_pages=64), mesh=mesh,
+    )
+    buckets = rng.integers(
+        -cfg.bucket_limit, cfg.bucket_limit + 1, global_batch
+    ).astype(np.int32)
+    packed = np.empty((global_batch, 3), dtype=np.int32)
+    packed[:, 0] = all_ids
+    packed[:, 1] = buckets
+    packed[:, 2] = 1
+    applied = pg.commit(packed)
+    assert applied == global_batch, applied
+    dense = pg.decode_dense(include_spill=True)
+    want = np.zeros((m, cfg.num_buckets), dtype=np.int64)
+    np.add.at(want, (all_ids, buckets + cfg.bucket_limit), 1)
+    np.testing.assert_array_equal(dense, want)
+    print(f"WORKER {pid} PAGED OK", flush=True)
+
     jax.distributed.shutdown()
     print(f"WORKER {pid} OK {total}", flush=True)
     return 0

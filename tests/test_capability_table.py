@@ -149,10 +149,12 @@ def test_paged_storage_fused_ok_admits_raw_transport():
         big, transport="preagg", fused_ok=True
     )
     assert reason is not None and "transport" in reason
-    reason = dispatch.paged_storage_incapability(
+    # r18: a mesh no longer blanket-disqualifies paged storage — the
+    # per-shard arenas admit it, and only genuinely unshardable SHAPES
+    # decline (see test_paged_mesh_shape_edges below)
+    assert dispatch.paged_storage_incapability(
         big, transport="raw", fused_ok=True, mesh=True
-    )
-    assert reason is not None and "mesh" in reason
+    ) is None
 
 
 def test_resolve_storage_path_fused_ok_flows_through():
@@ -203,11 +205,20 @@ def test_fused_paged_declined_edge_by_edge(monkeypatch):
         **_CAPABLE, crossover=False
     ) is None
     monkeypatch.setattr(dispatch, "FUSED_PAGED", True)
-    # mesh (shared with the fused-ingest row)
-    reason = dispatch.fused_paged_incapability(
+    # mesh (r18): unlike the dense fused kernel, the direct-to-paged
+    # step runs inside shard_map — a bool-only mesh is admitted, and a
+    # Mesh in hand declines only on batch/arena split shape
+    assert dispatch.fused_paged_incapability(
         **{**_CAPABLE, "mesh": True}
+    ) is None
+    reason = dispatch.fused_paged_incapability(
+        **{**_CAPABLE, "mesh": True},
+        mesh_obj=_MeshStub(
+            ("stream", "metric"), {"stream": 3, "metric": 1}
+        ),
     )
-    assert reason is not None and "shard_map" in reason
+    assert reason is not None and "mesh shape" in reason
+    assert "3-way stream axis" in reason
     # bucket axis (shared with the paged-storage row)
     reason = dispatch.fused_paged_incapability(
         **{**_CAPABLE, "num_buckets": dispatch.PAGE_SIZE - 1}
@@ -293,16 +304,69 @@ def test_full_path_explicit_fused_on_incapable_paged_raises():
         )
 
 
-def test_full_path_mesh_declines_everything_with_reasons():
+def test_full_path_unshardable_mesh_declines_with_reasons():
+    # r18: a mesh per se no longer disqualifies the paged routes, but a
+    # SHAPE the per-shard arenas cannot take still declines every
+    # contender with its own reason — here 2^20 rows over a 3-way
+    # metric axis
     mesh = _MeshStub(("stream", "metric"), {"stream": 2, "metric": 3})
     fp = dispatch.resolve_full_path(
         1 << 20, 8193, "tpu", batch_size=1 << 20, mesh=mesh
     )
     assert fp.storage == "dense"
     assert fp.commit == "fanout"
-    assert "shard_map" in fp.reasons["ingest:fused_paged"]
-    assert "mesh" in fp.reasons["storage:paged"]
+    assert "mesh shape" in fp.reasons["ingest:fused_paged"]
+    assert "3-way metric axis" in fp.reasons["storage:paged"]
     assert "3-way" in fp.reasons["commit:fused"]
+
+
+def test_full_path_capable_mesh_admits_paged_and_fused_paged():
+    # the r18 tentpole: the same resolution that declined every mesh in
+    # r17 now lands the one-dispatch route when the shape shards
+    mesh = _MeshStub(("stream", "metric"), {"stream": 2, "metric": 4})
+    fp = dispatch.resolve_full_path(
+        1 << 20, 8193, "tpu", batch_size=1 << 20, mesh=mesh
+    )
+    assert fp.storage == "paged"
+    assert fp.ingest == "fused_paged"
+    assert fp.transport == "raw"
+    assert fp.commit == "fused"
+    assert "storage:paged" not in fp.reasons
+    assert "ingest:fused_paged" not in fp.reasons
+
+
+def test_paged_mesh_shape_edges():
+    # every decline the relaxed r18 pool_mesh edge can produce, pinned
+    # verbatim-ish (the "mesh shape:" prefix is what degrade logs key on)
+    big = 1 << 20
+
+    def _reason(mesh_obj, num_metrics=big):
+        return dispatch.paged_storage_incapability(
+            num_metrics, mesh=True, mesh_obj=mesh_obj
+        )
+
+    # wrong axis layout
+    reason = _reason(_MeshStub(("x", "y"), {"x": 2, "y": 4}))
+    assert reason is not None and reason.startswith("mesh shape:")
+    assert "('stream', 'metric')" in reason
+    # rows don't shard over the metric axis
+    reason = _reason(_MeshStub(("stream", "metric"),
+                               {"stream": 2, "metric": 3}))
+    assert reason is not None and reason.startswith("mesh shape:")
+    assert "3-way metric axis" in reason and "page arenas" in reason
+    # commit chunk doesn't split over the stream axis
+    reason = _reason(
+        _MeshStub(("stream", "metric"), {"stream": 3, "metric": 1}),
+        num_metrics=big + big // 2,  # divisible by 1, chunk is the trip
+    )
+    assert reason is not None and reason.startswith("mesh shape:")
+    assert str(dispatch.PAGED_COMMIT_CHUNK) in reason
+    assert "3-way stream axis" in reason
+    # every v5e-8 factorization is admitted
+    for stream, metric in ((8, 1), (4, 2), (2, 4), (1, 8)):
+        mesh = _MeshStub(("stream", "metric"),
+                         {"stream": stream, "metric": metric})
+        assert _reason(mesh) is None, (stream, metric)
 
 
 def test_full_path_commit_stays_fused_on_capable_mesh():

@@ -294,9 +294,15 @@ def test_resolve_commit_path_explicit_fused_raises_the_reason():
 # -- paged storage resolution (r14) ------------------------------------- #
 
 def test_paged_storage_incapability_reason_strings():
-    # mesh wins over every other reason: the pool is a single-device arena
-    reason = dispatch.paged_storage_incapability(1 << 20, mesh=True)
-    assert reason is not None and "mesh" in reason
+    # r18: a mesh per se is admitted (per-shard arenas); only shapes
+    # the arenas cannot take decline, and they still win over every
+    # other reason
+    assert dispatch.paged_storage_incapability(1 << 20, mesh=True) is None
+    bad = _MeshStub(("stream", "metric"), {"stream": 2, "metric": 3})
+    reason = dispatch.paged_storage_incapability(
+        1 << 20, mesh=True, mesh_obj=bad, transport="raw"
+    )
+    assert reason is not None and "mesh shape" in reason
     # non-sparse transports ship whole batches, no host fold to translate
     reason = dispatch.paged_storage_incapability(1 << 20, transport="raw")
     assert reason is not None and "transport" in reason
@@ -330,10 +336,17 @@ def test_resolve_storage_path_auto_degrades_with_reason():
     )
     assert storage == "dense"
     assert reason is not None and "below crossover" in reason
+    # r18: a shardable mesh no longer degrades; an unshardable SHAPE does
     storage, reason = dispatch.resolve_storage_path(
         "auto", 1 << 20, 8193, "cpu", mesh=True
     )
-    assert storage == "dense" and "mesh" in reason
+    assert storage == "paged" and reason is None
+    storage, reason = dispatch.resolve_storage_path(
+        "auto", 1 << 20, 8193, "cpu", mesh=True,
+        mesh_obj=_MeshStub(("stream", "metric"),
+                           {"stream": 2, "metric": 3}),
+    )
+    assert storage == "dense" and "mesh shape" in reason
     storage, reason = dispatch.resolve_storage_path(
         "auto", 1 << 20, 8193, "cpu"
     )
@@ -351,9 +364,12 @@ def test_resolve_storage_path_explicit_paged_raises_the_reason():
     assert storage == "paged" and reason is None
     # ...but correctness blockers raise with the same reason string auto
     # degrades on
-    with pytest.raises(ValueError, match="mesh"):
-        dispatch.resolve_storage_path("paged", 1 << 20, 8193, "cpu",
-                                      mesh=True)
+    with pytest.raises(ValueError, match="mesh shape"):
+        dispatch.resolve_storage_path(
+            "paged", 1 << 20, 8193, "cpu", mesh=True,
+            mesh_obj=_MeshStub(("stream", "metric"),
+                               {"stream": 2, "metric": 3}),
+        )
     with pytest.raises(ValueError, match="transport"):
         dispatch.resolve_storage_path("paged", 1 << 20, 8193, "cpu",
                                       transport="raw")

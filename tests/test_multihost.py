@@ -82,6 +82,19 @@ def test_two_process_distributed_step():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    if any(
+        "Multiprocess computations aren't implemented" in out
+        for out in outs
+    ):
+        # this jaxlib's CPU backend cannot execute cross-process
+        # computations at all (no gloo collectives); the drill needs a
+        # real TPU pod or a collectives-enabled CPU build.  Skip with
+        # the reason rather than fail on an environment limitation.
+        pytest.skip(
+            "jaxlib CPU backend lacks multiprocess computation support; "
+            "the 2-process drill needs gloo collectives or a TPU pod"
+        )
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
         assert f"WORKER {i} OK 4096" in out, out[-3000:]
+        assert f"WORKER {i} PAGED OK" in out, out[-3000:]

@@ -434,8 +434,14 @@ def test_paged_refuses_multirow_and_nonsparse_transports():
         _mk_agg("paged", transport="preagg")
 
 
-def test_paged_is_incompatible_with_fused_commit_and_lifecycle():
-    from loghisto_tpu.commit import commit_incompatibility
+def test_paged_joins_fused_commit_and_lifecycle_but_not_anomaly():
+    # r18 retired the r14 refusals: a paged aggregator shares the fused
+    # commit program (the pool rides in the accumulator's carry slot)
+    # and LifecycleManager drives evict/compact/grow on it.  The one
+    # pairing that stays dense-only is the drift engine, whose
+    # interval-histogram carry IS a dense [M, B] tensor.
+    from loghisto_tpu.anomaly import AnomalyConfig, AnomalyManager
+    from loghisto_tpu.commit import IntervalCommitter, commit_incompatibility
     from loghisto_tpu.lifecycle import LifecycleConfig, LifecycleManager
     from loghisto_tpu.window import TimeWheel
 
@@ -443,10 +449,12 @@ def test_paged_is_incompatible_with_fused_commit_and_lifecycle():
     try:
         wheel = TimeWheel(num_metrics=64, config=CFG, interval=1.0,
                           tiers=[(4, 1)], registry=agg.registry)
-        reason = commit_incompatibility(agg, wheel)
-        assert reason is not None and "paged storage" in reason
-        with pytest.raises(ValueError, match="dense-only"):
-            LifecycleManager(agg, wheel, LifecycleConfig())
+        assert commit_incompatibility(agg, wheel) is None
+        lc = LifecycleManager(agg, wheel, LifecycleConfig())
+        assert lc is not None
+        an = AnomalyManager(agg, wheel, AnomalyConfig())
+        with pytest.raises(ValueError, match="dense accumulator"):
+            IntervalCommitter(agg, wheel, anomaly=an)
     finally:
         agg.close()
 
