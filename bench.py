@@ -300,11 +300,47 @@ def _probe_device(timeout_s: float = 240.0) -> str | None:
     return None
 
 
+def _preflight_analyzer(timeout_s: float = 240.0) -> None:
+    """Refuse to publish a BENCH artifact from a tree that fails its own
+    static contract analyzer: a number measured on a program whose
+    dispatch/donation/layout contracts are broken is not comparable to
+    any other round's.  ``LOGHISTO_SKIP_PREFLIGHT=1`` is the escape
+    hatch; analyzer *environment* failures (timeout, missing interpreter
+    features) degrade to a warning rather than blocking the bench."""
+    import os
+    import subprocess
+    import sys
+
+    if os.environ.get("LOGHISTO_SKIP_PREFLIGHT"):
+        print("bench: static-analysis preflight skipped via "
+              "LOGHISTO_SKIP_PREFLIGHT", file=sys.stderr)
+        return
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "loghisto_tpu.analysis"],
+            capture_output=True, text=True, timeout=timeout_s,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+    except (subprocess.TimeoutExpired, OSError) as exc:
+        print(f"bench: static-analysis preflight inconclusive ({exc}); "
+              "continuing", file=sys.stderr)
+        return
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stdout + proc.stderr)
+        raise SystemExit(
+            "bench: static contract analyzer failed — refusing to "
+            "publish a BENCH artifact from a failing tree "
+            "(set LOGHISTO_SKIP_PREFLIGHT=1 to override)"
+        )
+
+
 def main() -> None:
     import os
     import sys
 
     import jax
+
+    _preflight_analyzer()
 
     # The hang-then-fallback dance only applies to the tunneled axon TPU
     # platform; anywhere else (including when the caller already selected

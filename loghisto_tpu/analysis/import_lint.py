@@ -1,0 +1,233 @@
+"""Import-graph linter: AST-level module graph over ``loghisto_tpu/``
+enforcing the declared layering.
+
+Two rules:
+
+  * **jax-free frontier** — the modules that run inside emitter /
+    host-only processes (``federation.emitter``, ``labels.model``,
+    ``obs.spans``, ``metrics``) must not *transitively* reach jax (or
+    jaxlib/numpy-free accelerator deps) at import time.  The federation
+    drill proves this with a subprocess oracle; this pass proves it
+    statically on every run, with the offending import chain in the
+    finding.
+  * **lazy surfaces resolve** — the PEP 562 ``__getattr__`` surfaces in
+    ``loghisto_tpu/__init__.py`` and ``ops/__init__.py`` must resolve
+    every name they advertise in ``__all__`` (a renamed symbol behind a
+    lazy indirection otherwise fails only at first customer access).
+
+Only module-level imports count: an import inside a function body is a
+deliberate lazy import (the repo's standard idiom for breaking the
+frontier), and ``if TYPE_CHECKING:`` blocks never execute.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable
+
+from loghisto_tpu.analysis import Finding, REPO_ROOT
+
+PACKAGE = "loghisto_tpu"
+PACKAGE_ROOT = os.path.join(REPO_ROOT, PACKAGE)
+
+# Modules that must stay importable in a process with no accelerator
+# stack: the federation emitter tier, the label data model, the span
+# ring, and the host metrics registry.
+JAX_FREE_FRONTIER = (
+    "loghisto_tpu.federation.emitter",
+    "loghisto_tpu.labels.model",
+    "loghisto_tpu.obs.spans",
+    "loghisto_tpu.metrics",
+)
+
+# Top-level distributions the frontier must never reach at import time.
+FORBIDDEN_ROOTS = ("jax", "jaxlib")
+
+# Packages whose __getattr__-advertised names must resolve.
+LAZY_SURFACES = ("loghisto_tpu", "loghisto_tpu.ops")
+
+
+def _is_type_checking_test(test: ast.expr) -> bool:
+    return (isinstance(test, ast.Name) and test.id == "TYPE_CHECKING") or (
+        isinstance(test, ast.Attribute) and test.attr == "TYPE_CHECKING"
+    )
+
+
+def _module_level_imports(tree: ast.Module) -> Iterable[ast.stmt]:
+    """Import statements that execute at import time: module body plus
+    any try/if/with nesting — but not function bodies (lazy imports)
+    or TYPE_CHECKING blocks (never execute)."""
+    stack: list[ast.stmt] = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            yield node
+        elif isinstance(node, ast.If):
+            if not _is_type_checking_test(node.test):
+                stack.extend(node.body)
+            stack.extend(node.orelse)
+        elif isinstance(node, ast.Try):
+            stack.extend(node.body)
+            for handler in node.handlers:
+                stack.extend(handler.body)
+            stack.extend(node.orelse)
+            stack.extend(node.finalbody)
+        elif isinstance(node, (ast.With, ast.ClassDef)):
+            stack.extend(node.body)
+
+
+def _module_name(path: str, root: str = REPO_ROOT,
+                 package: str = PACKAGE) -> str | None:
+    rel = os.path.relpath(path, root)
+    if not rel.endswith(".py"):
+        return None
+    parts = rel[:-3].split(os.sep)
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    if not parts or parts[0] != package:
+        return None
+    return ".".join(parts)
+
+
+def build_import_graph(
+    package_root: str = PACKAGE_ROOT, package: str = PACKAGE,
+    repo_root: str = REPO_ROOT,
+) -> dict[str, list[tuple[str, str, int]]]:
+    """module -> [(imported module, file, line)] for every module-level
+    import in the package tree.  ``from pkg import name`` records both
+    ``pkg`` and ``pkg.name`` when the latter is itself a module."""
+    graph: dict[str, list[tuple[str, str, int]]] = {}
+    modules: set[str] = set()
+    files: dict[str, str] = {}
+    for dirpath, _dirnames, filenames in os.walk(package_root):
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            mod = _module_name(path, repo_root, package)
+            if mod is not None:
+                modules.add(mod)
+                files[mod] = path
+    for mod, path in files.items():
+        with open(path, "r", encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        edges: list[tuple[str, str, int]] = []
+        is_pkg = os.path.basename(path) == "__init__.py"
+        for node in _module_level_imports(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    edges.append((alias.name, path, node.lineno))
+            else:  # ImportFrom
+                if node.level:
+                    base_parts = mod.split(".")
+                    # a package's own __init__ resolves level-1 against
+                    # itself, a plain module against its parent package
+                    up = node.level - (1 if is_pkg else 0)
+                    if up:
+                        base_parts = base_parts[:-up]
+                    base = ".".join(base_parts)
+                    target = f"{base}.{node.module}" if node.module else base
+                else:
+                    target = node.module or ""
+                if target:
+                    edges.append((target, path, node.lineno))
+                for alias in node.names:
+                    sub = f"{target}.{alias.name}" if target else alias.name
+                    if sub in modules:
+                        edges.append((sub, path, node.lineno))
+        graph[mod] = edges
+    return graph
+
+
+def _closure_chain(
+    graph: dict, start: str, forbidden_roots: tuple,
+) -> tuple[list[str], str, int] | None:
+    """BFS the import-time closure of ``start``; on reaching a forbidden
+    root, return (module chain, offending file, line)."""
+    parent: dict[str, tuple[str, str, int] | None] = {start: None}
+    queue = [start]
+    while queue:
+        mod = queue.pop(0)
+        for target, path, line in graph.get(mod, ()):
+            root = target.split(".")[0]
+            if root in forbidden_roots:
+                chain = [target]
+                cursor: str | None = mod
+                while cursor is not None:
+                    chain.append(cursor)
+                    entry = parent[cursor]
+                    cursor = entry[0] if entry else None
+                return list(reversed(chain)), path, line
+            # importing pkg.sub executes pkg's __init__ too
+            parts = target.split(".")
+            for depth in range(1, len(parts) + 1):
+                prefix = ".".join(parts[:depth])
+                if prefix in graph and prefix not in parent:
+                    parent[prefix] = (mod, path, line)
+                    queue.append(prefix)
+    return None
+
+
+def frontier_findings(
+    frontier: tuple = JAX_FREE_FRONTIER,
+    forbidden_roots: tuple = FORBIDDEN_ROOTS,
+    graph: dict | None = None,
+) -> list[Finding]:
+    from loghisto_tpu.analysis import relpath
+
+    if graph is None:
+        graph = build_import_graph()
+    out: list[Finding] = []
+    for mod in frontier:
+        if mod not in graph:
+            out.append(Finding(
+                "imports", "loghisto_tpu/analysis/import_lint.py", 1,
+                mod, "frontier-missing",
+                f"declared jax-free frontier module {mod} does not "
+                "exist — update JAX_FREE_FRONTIER",
+            ))
+            continue
+        hit = _closure_chain(graph, mod, forbidden_roots)
+        if hit is not None:
+            chain, path, line = hit
+            out.append(Finding(
+                "imports", relpath(path), line, mod, f"jax-import:{chain[-1]}",
+                f"jax-free frontier module {mod} transitively imports "
+                f"{chain[-1]} at import time: {' -> '.join(chain)}",
+            ))
+    return out
+
+
+def lazy_surface_findings(
+    surfaces: tuple = LAZY_SURFACES,
+) -> list[Finding]:
+    """Resolve every ``__all__`` name of the PEP 562 surfaces.  This is
+    a *dynamic* check by design: the lazy indirection's whole failure
+    mode is a name that parses fine and only breaks on getattr."""
+    import importlib
+
+    out: list[Finding] = []
+    for modname in surfaces:
+        mod = importlib.import_module(modname)
+        path = getattr(mod, "__file__", modname) or modname
+        from loghisto_tpu.analysis import relpath
+
+        for name in getattr(mod, "__all__", ()):
+            try:
+                getattr(mod, name)
+            except Exception as exc:  # AttributeError or deeper ImportError
+                out.append(Finding(
+                    "imports", relpath(path), 1, modname,
+                    f"lazy-surface:{name}",
+                    f"{modname}.__all__ advertises {name!r} but "
+                    f"resolving it raises {type(exc).__name__}: {exc}",
+                ))
+    return out
+
+
+def run(include_dynamic: bool = True) -> list[Finding]:
+    out = frontier_findings()
+    if include_dynamic:
+        out.extend(lazy_surface_findings())
+    return out

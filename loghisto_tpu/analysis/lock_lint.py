@@ -1,0 +1,242 @@
+"""Concurrency-discipline lint: AST pass over ``loghisto_tpu/``.
+
+Two rules:
+
+  * **no blocking call under a lock** — ``block_until_ready`` /
+    ``device_get`` (device syncs that can stall for a full dispatch) and
+    blocking socket ops must not execute inside a ``with <lock>:``
+    block: every reader of that lock then stalls behind the device or
+    the peer.  The handful of deliberate cases (e.g. an observe-only
+    span sync) are pinned in ``analysis/baseline.py`` with reasons.
+  * **locked worker writes** — a function handed to a thread as an
+    entry point (``threading.Thread(target=...)``, ``ThreadSupervisor
+    .spawn(...)``) shares ``self`` with the spawning thread; plain
+    ``self.attr = ...`` writes from the worker body outside any ``with
+    <lock>:`` scope are unsynchronized publication.  Baseline entries
+    document today's benign cases (single-writer fields, monotonic
+    flags) instead of letting new ones land silently.
+
+Heuristics are name-based by design (a lock is anything whose terminal
+name contains ``lock``); the point is a cheap tripwire with a reviewed
+baseline, not an alias-analysis prover.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from loghisto_tpu.analysis import Finding, REPO_ROOT, relpath
+
+PACKAGE_ROOT = os.path.join(REPO_ROOT, "loghisto_tpu")
+
+# call-terminal-name -> what blocks
+BLOCKING_CALLS = {
+    "block_until_ready": "device sync",
+    "device_get": "blocking D2H readback",
+    "recv": "blocking socket read",
+    "recv_into": "blocking socket read",
+    "recvfrom": "blocking socket read",
+    "sendall": "blocking socket write",
+    "accept": "blocking socket accept",
+    "connect": "blocking socket connect",
+    "create_connection": "blocking socket connect",
+}
+
+
+def _terminal_name(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return _terminal_name(node.func)
+    return None
+
+
+def _lock_name(node: ast.expr) -> str | None:
+    """The lock a ``with`` item acquires, if its terminal name smells
+    like one (``self._lock``, ``shard.lock``, ``self._flush_lock``);
+    condition variables (``self._xfer_cv``) wrap a lock and count as
+    lock scope for both rules."""
+    name = _terminal_name(node)
+    if name is None:
+        return None
+    low = name.lower()
+    if "lock" in low or "cond" in low or low.endswith("_cv") or low == "cv":
+        return name
+    return None
+
+
+class _FunctionScanner(ast.NodeVisitor):
+    """Scan one function body tracking the with-lock nesting depth."""
+
+    def __init__(self, path: str, qualname: str, findings: list):
+        self.path = path
+        self.qualname = qualname
+        self.findings = findings
+        self.lock_stack: list[str] = []
+
+    # nested defs get their own scan via _iter_functions; don't descend
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_With(self, node: ast.With):
+        locks = [
+            _lock_name(item.context_expr) for item in node.items
+        ]
+        locks = [name for name in locks if name]
+        self.lock_stack.extend(locks)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in locks:
+            self.lock_stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call):
+        name = _terminal_name(node.func)
+        if name in BLOCKING_CALLS and self.lock_stack:
+            self.findings.append(Finding(
+                "locks", relpath(self.path), node.lineno,
+                self.qualname, f"blocking-under-lock:{name}",
+                f"{BLOCKING_CALLS[name]} `{name}` while holding "
+                f"`{self.lock_stack[-1]}` — every contender on the lock "
+                "stalls behind it",
+            ))
+        self.generic_visit(node)
+
+
+class _EntryScanner(ast.NodeVisitor):
+    """Find names handed to threads as entry points in one file."""
+
+    def __init__(self):
+        self.entry_names: set[str] = set()
+
+    def visit_Call(self, node: ast.Call):
+        callee = _terminal_name(node.func)
+        candidates: list[ast.expr] = []
+        if callee == "Thread":
+            candidates += [kw.value for kw in node.keywords
+                           if kw.arg == "target"]
+        elif callee == "spawn":
+            if node.args:
+                candidates.append(node.args[0])
+            candidates += [kw.value for kw in node.keywords
+                           if kw.arg in ("target", "fn")]
+        for cand in candidates:
+            if isinstance(cand, ast.Call):   # functools.partial(self.f,...)
+                cand = cand.args[0] if cand.args else cand.func
+            name = _terminal_name(cand)
+            if name:
+                self.entry_names.add(name)
+        self.generic_visit(node)
+
+
+def _iter_functions(tree: ast.Module):
+    """(qualname, node) for every def, including methods and nested."""
+    stack = [("", node) for node in tree.body]
+    while stack:
+        prefix, node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = f"{prefix}{node.name}"
+            yield qual, node
+            stack.extend((f"{qual}.", child) for child in node.body
+                         if isinstance(child, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef,
+                                               ast.ClassDef)))
+        elif isinstance(node, ast.ClassDef):
+            stack.extend((f"{node.name}.", child) for child in node.body)
+
+
+class _EntryBodyScanner(ast.NodeVisitor):
+    """Track with-lock scope inside a thread entry point and record
+    ``self.attr`` writes that happen outside every lock."""
+
+    def __init__(self):
+        self.lock_depth = 0
+        self.writes: dict[str, int] = {}
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_With(self, node: ast.With):
+        locked = any(_lock_name(i.context_expr) for i in node.items)
+        self.lock_depth += bool(locked)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.lock_depth -= bool(locked)
+
+    visit_AsyncWith = visit_With
+
+    def _record(self, target: ast.expr, lineno: int):
+        if (
+            self.lock_depth == 0
+            and isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            self.writes.setdefault(target.attr, lineno)
+
+    def visit_Assign(self, node: ast.Assign):
+        for target in node.targets:
+            self._record(target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._record(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        self._record(node.target, node.lineno)
+        self.generic_visit(node)
+
+
+def lint_file(path: str) -> list[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+
+    findings: list[Finding] = []
+    functions = list(_iter_functions(tree))
+
+    # rule 1: blocking calls under a lock, everywhere
+    for qualname, node in functions:
+        scanner = _FunctionScanner(path, qualname, findings)
+        for stmt in node.body:
+            scanner.visit(stmt)
+
+    # rule 2: unlocked self-writes in thread entry points
+    entries = _EntryScanner()
+    entries.visit(tree)
+    if entries.entry_names:
+        for qualname, node in functions:
+            if node.name not in entries.entry_names:
+                continue
+            body = _EntryBodyScanner()
+            for stmt in node.body:
+                body.visit(stmt)
+            for attr, lineno in sorted(
+                body.writes.items(), key=lambda kv: kv[1]
+            ):
+                findings.append(Finding(
+                    "locks", relpath(path), lineno, qualname,
+                    f"unlocked-worker-write:{attr}",
+                    f"thread entry point `{qualname}` writes shared "
+                    f"`self.{attr}` outside any lock scope",
+                ))
+    return findings
+
+
+def run(package_root: str = PACKAGE_ROOT) -> list[Finding]:
+    out: list[Finding] = []
+    for dirpath, _dirnames, filenames in os.walk(package_root):
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                out.extend(lint_file(os.path.join(dirpath, fname)))
+    return sorted(out, key=lambda f: (f.path, f.line))

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Repo lint gate: ruff (config in pyproject.toml [tool.ruff]) plus the
+# cheap static-analysis passes.  Exits nonzero on any finding.
+#
+# ruff is optional in the runtime image — when absent we fall back to a
+# full-bytecode compile (catches the E9 syntax class ruff would) so the
+# gate still means something in hermetic containers.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+if command -v ruff >/dev/null 2>&1; then
+    ruff check .
+else
+    echo "lint.sh: ruff not installed; falling back to compileall" >&2
+    python -m compileall -q loghisto_tpu tests benchmarks examples bench.py
+fi
+
+# The import/lock passes are pure-AST and run in well under a second;
+# the jaxpr pass needs device tracing and lives in the full analyzer
+# gate (`python -m loghisto_tpu.analysis`) run by tier-1 and bench.py.
+JAX_PLATFORMS=cpu python -m loghisto_tpu.analysis --pass imports --pass locks

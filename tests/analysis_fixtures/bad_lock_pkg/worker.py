@@ -1,0 +1,29 @@
+"""Known-bad concurrency fixture: a worker that synchronizes with the
+device while holding its lock, and a thread entry point that publishes
+shared state without taking it."""
+
+import threading
+
+import jax
+
+
+class BadWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._carry = None
+        self._busy = False
+
+    def start(self):
+        threading.Thread(target=self._run_loop, daemon=True).start()
+
+    def commit(self, carry):
+        with self._lock:
+            self._carry = carry
+            jax.block_until_ready(carry)   # device sync under the lock
+
+    def _run_loop(self):
+        self._busy = True                  # unlocked shared-state write
+        while self._busy:
+            with self._lock:
+                if self._carry is None:
+                    self._busy = False

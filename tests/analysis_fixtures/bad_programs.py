@@ -1,0 +1,85 @@
+"""Known-bad compiled programs for the jaxpr contract auditor.
+
+Each ProgramSpec here carries the contract its program VIOLATES, so
+auditing this file must produce findings (the analyzer CLI exits
+nonzero).  tests/test_contracts.py pins the exact finding details.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from loghisto_tpu.analysis.jaxpr_audit import Contract, ProgramSpec
+
+PM, B = 40, 129         # the registry's unambiguous paged [M, B] shape
+POOL = (48, 256)
+
+
+def _build_two_dispatch():
+    """Violates the dispatch budget: the step launches two programs."""
+
+    @jax.jit
+    def fold(acc, weights):
+        return acc.at[0].add(weights)
+
+    @jax.jit
+    def scale(acc):
+        return acc * 2
+
+    def step(acc, weights):
+        return scale(fold(acc, weights))
+
+    return step, (jnp.zeros((8, B), jnp.int32), jnp.zeros((B,), jnp.int32))
+
+
+def _build_dropped_donation():
+    """Declares a donated carry but returns a different-dtype result, so
+    XLA silently drops the donation (no output aliases the operand)."""
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(acc, weights):
+        return (acc.at[0].add(weights)).astype(jnp.float32)
+
+    return step, (jnp.zeros((8, B), jnp.int32), jnp.zeros((B,), jnp.int32))
+
+
+def _build_dense_leak():
+    """A 'paged' route that materializes the dense [M, B] tensor the
+    paged storage design exists to avoid."""
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(pool, rows, weights):
+        dense = jnp.zeros((PM, B), jnp.int32)           # the leak
+        dense = dense.at[rows, 0].add(weights)
+        return pool + dense.sum()
+
+    return step, (
+        jnp.zeros(POOL, jnp.int32),
+        jnp.zeros((16,), jnp.int32),
+        jnp.zeros((16,), jnp.int32),
+    )
+
+
+PROGRAMS = (
+    ProgramSpec(
+        "fixture_two_dispatch", "tests.analysis_fixtures.bad_programs",
+        _build_two_dispatch,
+        Contract(dispatches=1, pallas_calls=None, donated=None,
+                 stream_psums=None),
+    ),
+    ProgramSpec(
+        "fixture_dropped_donation",
+        "tests.analysis_fixtures.bad_programs",
+        _build_dropped_donation,
+        Contract(dispatches=1, pallas_calls=None, donated=1,
+                 stream_psums=None),
+    ),
+    ProgramSpec(
+        "fixture_dense_leak", "tests.analysis_fixtures.bad_programs",
+        _build_dense_leak,
+        Contract(dispatches=1, pallas_calls=None, donated=1,
+                 stream_psums=None,
+                 forbidden_shapes=((PM, B), (PM // 2, B))),
+    ),
+)

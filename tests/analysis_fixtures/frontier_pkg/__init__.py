@@ -1,0 +1,2 @@
+"""Fixture package whose `emitter` module breaks the jax-free frontier
+contract (eager jax import); never imported by production code."""

@@ -1,0 +1,10 @@
+"""Known-bad frontier module: a 'jax-free' emitter that imports jax at
+module level through a local indirection — the transitive case the
+import-graph lint must catch (a direct grep for `import jax` in the
+frontier file itself would miss it)."""
+
+from . import helper  # noqa: F401
+
+
+def emit(frame):
+    return helper.encode(frame)

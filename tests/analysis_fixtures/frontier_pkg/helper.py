@@ -1,0 +1,7 @@
+"""The indirection that drags jax into the fixture frontier."""
+
+import jax  # noqa: F401  (the violation under test)
+
+
+def encode(frame):
+    return bytes(frame)

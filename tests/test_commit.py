@@ -214,6 +214,24 @@ def test_one_dispatch_one_upload_per_interval_with_three_tiers():
         store_mod._open_slot_jit = real_open
 
 
+def test_fused_commit_static_contracts():
+    # the runtime dispatch counter above proves the ≤2-dispatch budget
+    # end-to-end; the static auditor (ISSUE 20) pins the same programs'
+    # trace-level contracts — dispatch count, donation aliasing, int32
+    # scatter discipline — for every fused-commit variant at once
+    from loghisto_tpu.analysis.jaxpr_audit import assert_contract
+
+    for name in (
+        "fused_commit",
+        "fused_commit_full",
+        "fused_commit_snapshot",
+        "fused_commit_snapshot_full",
+        "paged_fused_commit",
+        "paged_fused_commit_snapshot",
+    ):
+        assert_contract(name)
+
+
 # ---------------------------------------------------------------------- #
 # spill routing: the int32 envelope falls back to the exact fan-out
 # ---------------------------------------------------------------------- #

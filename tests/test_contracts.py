@@ -1,0 +1,202 @@
+"""Tier-1 gate for the static contract analyzer (ISSUE 20).
+
+Runs the analyzer's three passes in-process (the registry trace cache
+is shared with the per-test ``assert_contract`` delegations across the
+suite), drives the real CLI once for the exit-code contract, and pins
+the analyzer's detection power against the known-bad fixtures under
+``tests/analysis_fixtures/``.
+"""
+
+import importlib.util
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from loghisto_tpu.analysis import Finding, apply_baseline
+from loghisto_tpu.analysis import import_lint, lock_lint
+from loghisto_tpu.analysis.jaxpr_audit import (
+    PROGRAMS,
+    assert_contract,
+    audit_spec,
+    constant_findings,
+    get_spec,
+    program_names,
+)
+
+pytestmark = pytest.mark.static
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "analysis_fixtures"
+
+# Programs the ISSUE's acceptance criteria name explicitly: the paged
+# routes among them must declare the no-dense-[M, B] rule.
+CORE_PROGRAMS = {
+    "fused_commit", "fused_commit_snapshot",
+    "sharded_fused_commit", "sharded_fused_commit_snapshot",
+    "fused_ingest", "fused_paged_ingest", "sharded_fused_paged_ingest",
+    "paged_commit_jnp", "sparse_ingest_jnp", "snapshot_query",
+    "group_query", "fold_evict", "compact", "divergence",
+}
+PAGED_PROGRAMS = {
+    "paged_fused_commit", "paged_fused_commit_snapshot",
+    "sharded_paged_fused_commit", "sharded_paged_fused_commit_snapshot",
+    "fused_paged_ingest", "sharded_fused_paged_ingest",
+    "paged_commit_jnp", "paged_commit_pallas", "sharded_paged_commit",
+    "paged_query",
+}
+
+
+def _cli(*args, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "loghisto_tpu.analysis", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def _load_fixture_programs():
+    spec = importlib.util.spec_from_file_location(
+        "analysis_fixture_programs", FIXTURES / "bad_programs.py"
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return {p.name: p for p in module.PROGRAMS}
+
+
+# ---------------------------------------------------------------------- #
+# registry shape
+# ---------------------------------------------------------------------- #
+
+
+def test_registry_covers_every_program_family():
+    names = set(program_names())
+    assert len(names) >= 12, names
+    missing = CORE_PROGRAMS - names
+    assert not missing, f"registry lost core programs: {missing}"
+    for spec in PROGRAMS:
+        c = spec.contract
+        # acceptance: every entry declares dispatch count, pallas_call
+        # count, and donation — no opt-outs in the registry
+        assert c.dispatches is not None, spec.name
+        assert c.pallas_calls is not None, spec.name
+        assert c.donated is not None, spec.name
+        assert c.stream_psums is not None, spec.name
+        sharded = spec.name.startswith("sharded_")
+        assert c.stream_psums == (1 if sharded else 0), spec.name
+    for name in PAGED_PROGRAMS:
+        assert get_spec(name).contract.forbidden_shapes, (
+            f"paged route {name} must declare the no-dense-[M,B] rule"
+        )
+
+
+def test_head_satisfies_every_contract():
+    for name in program_names():
+        assert_contract(name)
+    assert constant_findings() == []
+
+
+def test_import_and_lock_passes_clean_on_head():
+    findings = import_lint.run() + lock_lint.run()
+    survivors = apply_baseline(findings, passes=("imports", "locks"))
+    assert survivors == [], "\n".join(f.render() for f in survivors)
+
+
+def test_stale_baseline_entry_is_itself_a_finding():
+    ghost = ("locks", "loghisto_tpu/nope.py", "Gone.fn",
+             "blocking-under-lock:recv", "was fine once")
+    survivors = apply_baseline([], baseline=[ghost])
+    assert len(survivors) == 1
+    assert survivors[0].detail == "stale-suppression"
+    # ...and a matching finding consumes the entry without surviving
+    real = Finding("locks", "loghisto_tpu/nope.py", 3, "Gone.fn",
+                   "blocking-under-lock:recv", "whatever")
+    assert apply_baseline([real], baseline=[ghost]) == []
+
+
+def test_unknown_program_name_is_loud():
+    with pytest.raises(KeyError, match="unknown audited program"):
+        get_spec("not_a_program")
+
+
+# ---------------------------------------------------------------------- #
+# detection power: the known-bad fixtures
+# ---------------------------------------------------------------------- #
+
+
+def test_fixture_two_dispatch_caught():
+    findings = audit_spec(_load_fixture_programs()["fixture_two_dispatch"])
+    assert any(f.detail == "dispatch-count" for f in findings), findings
+
+
+def test_fixture_dropped_donation_caught():
+    findings = audit_spec(
+        _load_fixture_programs()["fixture_dropped_donation"]
+    )
+    assert any(f.detail == "donation-alias" for f in findings), findings
+
+
+def test_fixture_dense_mb_leak_caught():
+    findings = audit_spec(_load_fixture_programs()["fixture_dense_leak"])
+    assert any(f.detail == "forbidden-shape" for f in findings), findings
+    reason = next(f for f in findings
+                  if f.detail == "forbidden-shape").reason
+    assert "(40, 129)" in reason and "paged route" in reason
+
+
+def test_fixture_eager_jax_frontier_caught():
+    graph = import_lint.build_import_graph(
+        package_root=str(FIXTURES / "frontier_pkg"),
+        package="frontier_pkg",
+        repo_root=str(FIXTURES),
+    )
+    findings = import_lint.frontier_findings(
+        frontier=("frontier_pkg.emitter",), graph=graph,
+    )
+    assert len(findings) == 1
+    assert "transitively imports jax" in findings[0].reason
+    assert "frontier_pkg.helper" in findings[0].reason  # the chain
+
+
+def test_fixture_lock_held_sync_caught():
+    findings = lock_lint.lint_file(
+        str(FIXTURES / "bad_lock_pkg" / "worker.py")
+    )
+    details = {f.detail for f in findings}
+    assert "blocking-under-lock:block_until_ready" in details, findings
+    assert "unlocked-worker-write:_busy" in details, findings
+
+
+# ---------------------------------------------------------------------- #
+# the CLI gate itself
+# ---------------------------------------------------------------------- #
+
+
+def test_cli_exits_zero_on_head():
+    proc = _cli()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_exits_nonzero_on_bad_fixture_programs():
+    proc = _cli(
+        "--pass", "jaxpr",
+        "--programs", str(FIXTURES / "bad_programs.py"),
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    for phrase in ("dispatch", "donation", "dense intermediate"):
+        assert phrase in proc.stdout, (phrase, proc.stdout)
+
+
+def test_cli_exits_nonzero_on_bad_frontier_and_locks():
+    proc = _cli(
+        "--pass", "imports", "--root", str(FIXTURES),
+        "--package", "frontier_pkg",
+        "--frontier", "frontier_pkg.emitter",
+    )
+    assert proc.returncode == 1
+    assert "transitively imports jax" in proc.stdout
+    proc = _cli(
+        "--pass", "locks", "--root", str(FIXTURES / "bad_lock_pkg"),
+    )
+    assert proc.returncode == 1
+    assert "while holding" in proc.stdout

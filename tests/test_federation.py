@@ -84,6 +84,23 @@ def _send_raw(port, data):
         s.sendall(data)
 
 
+# -- jax-free frontier: fast static proof ------------------------------- #
+# The 32-subprocess drill at the bottom of this file remains the runtime
+# oracle (its worker asserts "jax" not in sys.modules after importing the
+# emitter); this static check gives every tier-1 run the same guarantee in
+# milliseconds and names the offending import chain when it regresses.
+
+
+def test_emitter_import_closure_is_statically_jax_free():
+    from loghisto_tpu.analysis import import_lint
+
+    findings = import_lint.frontier_findings()
+    assert findings == [], "\n".join(f.render() for f in findings)
+    # the PEP 562 lazy surfaces must stay lazy too — an eager re-export
+    # would drag jax into the emitter's closure via the package __init__
+    assert import_lint.lazy_surface_findings() == []
+
+
 # -- frame codec fuzz (satellite: shared framing entry point) ----------- #
 
 

@@ -58,5 +58,6 @@ def test_subsystem_markers_are_in_use():
     for marker in ("window", "commit", "query", "lifecycle",
                    "ingest_transport", "anomaly", "mesh_commit", "obs",
                    "chaos", "federation", "fleet_obs", "ingest_fused",
-                   "paged", "labels", "ingest_paged", "mesh_paged"):
+                   "paged", "labels", "ingest_paged", "mesh_paged",
+                   "static"):
         assert marker in used, f"declared marker {marker!r} now unused"

@@ -366,3 +366,25 @@ def test_resolve_full_path_admits_paged_routes_on_capable_mesh():
     assert fp.commit == "fused"
     assert "storage:paged" not in fp.reasons
     assert "ingest:fused_paged" not in fp.reasons
+
+
+# ---------------------------------------------------------------------- #
+# static contracts for every sharded paged program (ISSUE 20): exactly
+# one stream-axis psum, donated carries alias outputs, and no dense
+# [M, B] tensor anywhere in the traced programs
+# ---------------------------------------------------------------------- #
+
+
+def test_sharded_paged_static_contracts():
+    from loghisto_tpu.analysis.jaxpr_audit import assert_contract
+
+    for name in (
+        "sharded_paged_commit",
+        "sharded_paged_fused_commit",
+        "sharded_paged_fused_commit_snapshot",
+        "sharded_fused_paged_ingest",
+        "paged_commit_jnp",
+        "paged_commit_pallas",
+        "paged_query",
+    ):
+        assert_contract(name)
